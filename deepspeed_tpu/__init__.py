@@ -1,0 +1,61 @@
+"""deepspeed_tpu — a TPU-native training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability surface of DeepSpeed
+(reference: ``deepspeed/__init__.py``). The one-call entry point mirrors
+``deepspeed.initialize()`` (reference :93): hand in a model + JSON config, get back an
+engine with ``forward/backward/step`` plus data loader and LR scheduler.
+"""
+
+from deepspeed_tpu.version import __version__  # noqa: F401
+
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.config import DeepSpeedTpuConfig, from_config  # noqa: F401
+from deepspeed_tpu.parallel import Topology, build_mesh  # noqa: F401
+
+
+def initialize(model=None, config=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mesh=None, dist_init_required=None,
+               collate_fn=None, config_params=None):
+    """Build the training engine (parity: ``deepspeed.initialize`` __init__.py:93).
+
+    Args:
+        model: a model spec — any object exposing ``init(rng) -> params`` and
+            ``apply(params, batch) -> loss`` (see ``deepspeed_tpu.models``), or a flax
+            module wrapped with ``deepspeed_tpu.models.FlaxModelSpec``.
+        config: dict / path to JSON / :class:`DeepSpeedTpuConfig`.
+        optimizer: optional pre-built optax transformation (overrides config optimizer).
+        training_data: optional dataset for the engine-managed data loader.
+        lr_scheduler: optional schedule fn ``step -> lr`` (overrides config scheduler).
+        mesh: optional pre-built :class:`Topology`.
+
+    Returns:
+        (engine, optimizer, training_dataloader, lr_scheduler) — same 4-tuple as the
+        reference.
+    """
+    try:
+        from deepspeed_tpu.runtime.engine import DeepSpeedTpuEngine
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "deepspeed_tpu.runtime.engine is not available in this build") from e
+
+    if config is None and config_params is not None:
+        config = config_params
+    ds_config = from_config(config)
+    comm.init_distributed()
+    engine = DeepSpeedTpuEngine(
+        model=model,
+        config=ds_config,
+        optimizer=optimizer,
+        training_data=training_data,
+        lr_scheduler=lr_scheduler,
+        topology=mesh,
+        collate_fn=collate_fn,
+    )
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build the inference engine (parity: ``deepspeed.init_inference`` __init__.py:328)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)

@@ -1,0 +1,100 @@
+"""Collective-op logging.
+
+Parity target: ``deepspeed/utils/comms_logging.py`` — ``CommsLogger`` (:67) and the
+``timed_op`` decorator (``deepspeed/comm/comm.py:106``). Inside ``jit`` collectives are
+compiler-scheduled, so per-op wall-clock timing is only meaningful eagerly; at trace
+time we record op name + message size + participating axis, which is what the busbw
+accounting needs. ``log_summary()`` mirrors ``dist.log_summary``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0:
+            return f"{n:.2f} {unit}"
+        n /= 1024.0
+    return f"{n:.2f} PB"
+
+
+class CommsLogger:
+    """Records (count, total bytes, eager latencies) per collective op name."""
+
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(list))
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.bytes: Dict[str, float] = defaultdict(float)
+
+    def configure(self, config) -> None:
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.prof_ops = list(config.prof_ops)
+        self.debug = config.debug
+
+    def should_log(self, op_name: str) -> bool:
+        return self.enabled and (self.prof_all or op_name in self.prof_ops)
+
+    def append(self, op_name: str, msg_bytes: int, latency_s: Optional[float] = None,
+               log_name: Optional[str] = None) -> None:
+        if not self.should_log(op_name):
+            return
+        self.counts[op_name] += 1
+        self.bytes[op_name] += msg_bytes
+        if latency_s is not None:
+            self.comms_dict[op_name][msg_bytes].append(latency_s)
+        if self.verbose:
+            extra = f" lat={latency_s * 1e3:.3f}ms" if latency_s is not None else ""
+            log_dist(f"comm: {log_name or op_name} size={_human_bytes(msg_bytes)}{extra}")
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        lines = ["Comm. Op            Count      Total Size     Avg Latency"]
+        for op, count in sorted(self.counts.items()):
+            total = self.bytes[op]
+            lats = [v for sizes in self.comms_dict[op].values() for v in sizes]
+            avg_lat = (sum(lats) / len(lats) * 1e3) if lats else float("nan")
+            lat_s = f"{avg_lat:10.3f} ms" if lats else "   (traced)"
+            lines.append(f"{op:<20}{count:<11}{_human_bytes(total):<15}{lat_s}")
+        out = "\n".join(lines)
+        log_dist(out)
+        return out
+
+    def reset(self) -> None:
+        self.comms_dict.clear()
+        self.counts.clear()
+        self.bytes.clear()
+
+
+# module-level singleton, mirroring the reference's global comms logger
+comms_logger = CommsLogger()
+
+
+class timed_op:
+    """Context manager timing an eager collective and appending to the logger."""
+
+    def __init__(self, name: str, msg_bytes: int):
+        self.name = name
+        self.msg_bytes = msg_bytes
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        comms_logger.append(self.name, self.msg_bytes, time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,17 @@
+from deepspeed_tpu.config.config import (  # noqa: F401
+    ActivationCheckpointingConfig,
+    BF16Config,
+    DeepSpeedTpuConfig,
+    FP16Config,
+    MeshConfig,
+    MoEConfig,
+    OffloadOptimizerConfig,
+    OffloadParamConfig,
+    OptimizerConfig,
+    PipelineConfig,
+    SchedulerConfig,
+    ZeroConfig,
+    ZeroStageEnum,
+    from_config,
+)
+from deepspeed_tpu.config.config_utils import AUTO, DSTpuConfigModel  # noqa: F401

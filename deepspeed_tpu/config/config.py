@@ -1,0 +1,387 @@
+"""Root configuration.
+
+Parity target: ``deepspeed/runtime/config.py`` — ``DeepSpeedConfig`` (:676) plus the
+per-feature ``*_config.py`` pydantic models (e.g. ``deepspeed/runtime/zero/config.py:90``).
+A single JSON/dict config instantiates typed sub-configs; ``train_batch_size =
+micro_batch * grad_accum * dp_world_size`` triple resolution matches the reference.
+
+TPU-specific addition: ``mesh`` — named-axis sizes for the single ``jax.sharding.Mesh``
+that replaces the reference's process-group factory (``deepspeed/utils/groups.py``).
+"""
+
+from __future__ import annotations
+
+import json
+from enum import IntEnum
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import AUTO, DSTpuConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+
+class ZeroStageEnum(IntEnum):
+    """Mirror of ``deepspeed/runtime/zero/config.py:81``."""
+
+    disabled = 0
+    optimizer_states = 1
+    gradients = 2
+    weights = 3
+    max_stage = 3
+
+
+class MeshConfig(DSTpuConfigModel):
+    """Named mesh-axis sizes. ``dp`` may be "auto" (fills remaining devices).
+
+    Axis order (outer→inner) is chosen so the fastest-varying axes sit on ICI:
+    pp (DCN-friendly, outermost) → dp → fsdp → ep → sp → tp (innermost, ICI).
+    """
+
+    pp: int = 1
+    dp: Union[int, Literal["auto"]] = AUTO
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+    # number of slices connected over DCN; 1 = single slice (all-ICI)
+    num_slices: int = 1
+
+    def resolved_dp(self, n_devices: int) -> int:
+        fixed = self.pp * self.fsdp * self.ep * self.sp * self.tp
+        if self.dp == AUTO:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by fixed mesh axes product {fixed}")
+            return n_devices // fixed
+        return int(self.dp)
+
+
+class OptimizerConfig(DSTpuConfigModel):
+    """``optimizer`` section: ``{"type": "AdamW", "params": {...}}``."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DSTpuConfigModel):
+    """``scheduler`` section, e.g. WarmupLR / WarmupDecayLR / WarmupCosineLR."""
+
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class FP16Config(DSTpuConfigModel):
+    """Dynamic loss scaling config (reference: ``runtime/fp16/loss_scaler.py:187``).
+
+    On TPU bf16 is the native precision and loss scaling is normally unnecessary;
+    fp16 mode is kept for parity and for fp16-mandatory hardware generations.
+    """
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DSTpuConfigModel):
+    enabled: bool = True
+    # keep a fp32 master copy of params in optimizer state (BF16_Optimizer parity)
+    master_weights: bool = True
+    immediate_grad_update: bool = True
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadParamConfig(DSTpuConfigModel):
+    """``zero_optimization.offload_param`` (ZeRO-Infinity param offload)."""
+
+    device: str = "none"  # none|cpu|nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(DSTpuConfigModel):
+    """``zero_optimization.offload_optimizer`` (ZeRO-Offload / Infinity)."""
+
+    device: str = "none"  # none|cpu|nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class ZeroConfig(DSTpuConfigModel):
+    """``zero_optimization`` section (reference: ``deepspeed/runtime/zero/config.py:90``).
+
+    Stage semantics on TPU:
+      0 — params/grads/opt-state replicated over dp; grad psum.
+      1 — optimizer state sharded over the zero axis; grads reduce then local shard update.
+      2 — grads reduce-scattered into the shard layout (XLA emits reduce_scatter).
+      3 — params sharded over the zero axis at rest; XLA SPMD all-gathers per use
+          (the prefetch/release machinery of stage3.py collapses into the XLA
+          latency-hiding scheduler plus scanned-layer structure).
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: Optional[bool] = None
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    # params smaller than this stay replicated (Z3 persistence threshold parity,
+    # stage3.py param_persistence_threshold)
+    param_persistence_threshold: int = 100_000
+    model_persistence_threshold: int = 9999999999
+    max_live_parameters: int = 1_000_000_000
+    prefetch_bucket_size: int = 50_000_000
+    # ZeRO++ knobs
+    zero_quantized_weights: bool = False       # qwZ: quantized weight all-gather
+    zero_quantized_gradients: bool = False     # qgZ: quantized grad reduce
+    zero_hpz_partition_size: int = 1           # hpZ: secondary (slice-local) param shard
+    # MiCS-style sub-mesh sharding
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    zero_allow_untested_optimizer: bool = True
+    ignore_unused_parameters: bool = True
+    use_multi_rank_bucket_allreduce: bool = True
+
+    @model_validator(mode="after")
+    def _check_stage(self):
+        if not 0 <= int(self.stage) <= 3:
+            raise ValueError(f"zero stage must be 0..3, got {self.stage}")
+        return self
+
+
+class ActivationCheckpointingConfig(DSTpuConfigModel):
+    """``activation_checkpointing`` — maps to ``jax.checkpoint`` policies over scanned
+    blocks (reference: ``runtime/activation_checkpointing/checkpointing.py:948``)."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # jax-native: which remat policy to apply to each scanned block
+    policy: str = "none"  # none|full|dots_saveable|nothing_saveable|offload_dots
+
+
+class CommsLoggerConfig(DSTpuConfigModel):
+    """``comms_logger`` (reference: ``deepspeed/utils/comms_logging.py:67``)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class MonitorBackendConfig(DSTpuConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTpuJobName"
+    team: Optional[str] = None
+    project: Optional[str] = None
+    group: Optional[str] = None
+
+
+class MonitorConfig(DSTpuConfigModel):
+    tensorboard: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
+    wandb: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
+    csv_monitor: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
+
+
+class FlopsProfilerConfig(DSTpuConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class DataTypesConfig(DSTpuConfigModel):
+    grad_accum_dtype: Optional[str] = None  # fp32|bf16|fp16|None(=param dtype)
+
+
+class GradientCompressionConfig(DSTpuConfigModel):
+    """1-bit-Adam-style compressed gradient collectives (runtime/comm/compressed.py)."""
+
+    enabled: bool = False
+    bits: int = 1
+
+
+class CheckpointConfig(DSTpuConfigModel):
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    tag_validation: str = "Warn"  # Ignore|Warn|Fail
+    load_universal: bool = False
+    async_save: bool = False
+
+
+class SequenceParallelConfig(DSTpuConfigModel):
+    """Long-context config: Ulysses (all-to-all) or ring attention over sp axis."""
+
+    mode: str = "ulysses"  # ulysses|ring
+    overlap_comm: bool = False
+
+
+class MoEConfig(DSTpuConfigModel):
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    use_rts: bool = True  # random token selection
+    noisy_gate_policy: Optional[str] = None  # None|Jitter|RSample
+
+
+class PipelineConfig(DSTpuConfigModel):
+    stages: Union[int, Literal["auto"]] = AUTO
+    partition_method: str = "parameters"  # parameters|uniform|type:regex
+    micro_batches: Union[int, Literal["auto"]] = AUTO
+    activation_checkpoint_interval: int = 0
+    pipe_schedule: str = "1f1b"  # 1f1b|gpipe
+
+
+class DeepSpeedTpuConfig(DSTpuConfigModel):
+    """The root config. Accepts a dict or a JSON file path via :func:`from_config`."""
+
+    train_batch_size: Union[int, Literal["auto"], None] = None
+    train_micro_batch_size_per_gpu: Union[int, Literal["auto"], None] = None
+    gradient_accumulation_steps: Union[int, Literal["auto"], None] = None
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+
+    gradient_clipping: float = 0.0
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    dump_state: bool = False
+    seed: int = 42
+    # torch-style "zero_force_ds_cpu_optimizer" etc. have no TPU meaning; omitted.
+
+    # ---- aliases / legacy keys ----
+    @model_validator(mode="before")
+    @classmethod
+    def _legacy_keys(cls, values):
+        if isinstance(values, dict):
+            if "tensorboard" in values:  # old flat monitor keys
+                values.setdefault("monitor_config", {})["tensorboard"] = values.pop("tensorboard")
+            if "csv_monitor" in values:
+                values.setdefault("monitor_config", {})["csv_monitor"] = values.pop("csv_monitor")
+            if "wandb" in values:
+                values.setdefault("monitor_config", {})["wandb"] = values.pop("wandb")
+        return values
+
+    # ---- batch triple resolution (reference config.py `_batch_assertion`) ----
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Fill in the missing member(s) of (train_batch, micro_batch, grad_accum).
+
+        ``train_batch_size == micro_batch * grad_accum * dp_world_size`` must hold.
+        """
+        tb = None if self.train_batch_size in (None, AUTO) else int(self.train_batch_size)
+        mb = (None if self.train_micro_batch_size_per_gpu in (None, AUTO)
+              else int(self.train_micro_batch_size_per_gpu))
+        ga = (None if self.gradient_accumulation_steps in (None, AUTO)
+              else int(self.gradient_accumulation_steps))
+
+        if tb and mb and ga:
+            if tb != mb * ga * dp_world_size:
+                raise ValueError(
+                    f"train_batch_size {tb} != micro_batch {mb} * grad_accum {ga} "
+                    f"* dp_world_size {dp_world_size}")
+        elif tb and mb:
+            if tb % (mb * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by micro_batch*dp "
+                    f"{mb * dp_world_size}")
+            ga = tb // (mb * dp_world_size)
+        elif tb and ga:
+            if tb % (ga * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by grad_accum*dp "
+                    f"{ga * dp_world_size}")
+            mb = tb // (ga * dp_world_size)
+        elif mb and ga:
+            tb = mb * ga * dp_world_size
+        elif mb:
+            ga = 1
+            tb = mb * dp_world_size
+        elif tb:
+            ga = 1
+            if tb % dp_world_size != 0:
+                raise ValueError(f"train_batch_size {tb} not divisible by dp {dp_world_size}")
+            mb = tb // dp_world_size
+        else:
+            raise ValueError(
+                "at least one of train_batch_size / train_micro_batch_size_per_gpu "
+                "must be set")
+
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = ga
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def print_config(self) -> None:
+        logger.info("DeepSpeedTpuConfig:\n" + json.dumps(self.model_dump(), indent=2, default=str))
+
+
+def from_config(config: Union[str, Dict[str, Any], DeepSpeedTpuConfig, None]) -> DeepSpeedTpuConfig:
+    """Build the root config from a dict, JSON file path, or pass through an instance."""
+    if config is None:
+        return DeepSpeedTpuConfig()
+    if isinstance(config, DeepSpeedTpuConfig):
+        return config
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    assert isinstance(config, dict), f"unsupported config type {type(config)}"
+    return DeepSpeedTpuConfig(**config)

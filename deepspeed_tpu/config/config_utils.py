@@ -1,0 +1,58 @@
+"""Config base classes.
+
+Parity target: ``deepspeed/runtime/config_utils.py`` — ``DeepSpeedConfigModel`` (:17):
+pydantic models with extra-field rejection, ``"auto"`` placeholder support, and
+deprecated-field migration. Rebuilt on pydantic v2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTO = "auto"
+
+
+class DSTpuConfigModel(BaseModel):
+    """Base for all config sub-models.
+
+    Fields may be declared with ``"auto"`` as their value; consumers resolve them
+    (HF integration / autotuner / engine) before use. Unknown keys are rejected so
+    config typos fail loudly, matching the reference's ``extra="forbid"`` behavior.
+    """
+
+    model_config = ConfigDict(
+        extra="forbid",
+        validate_assignment=True,
+        populate_by_name=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, **data: Any):
+        # drop explicit nulls so defaults apply, like the reference; a JSON `null`
+        # means "use default". (No flag parameter here — it would shadow a config key.)
+        data = {k: v for k, v in data.items() if v is not None or k.startswith("_")}
+        super().__init__(**data)
+
+    def is_auto(self, field: str) -> bool:
+        return getattr(self, field, None) == AUTO
+
+    def resolve_auto(self, field: str, value: Any) -> None:
+        if self.is_auto(field):
+            setattr(self, field, value)
+
+    def dict_repr(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+def get_scalar_param(param_dict: Dict[str, Any], name: str, default: Any) -> Any:
+    """Legacy-style scalar read used for dict sub-sections not yet pydantic-modeled."""
+    return param_dict.get(name, default)
+
+
+def warn_deprecated(old: str, new: str) -> None:
+    logger.warning(f"config field '{old}' is deprecated; use '{new}'")

@@ -1,0 +1,7 @@
+from deepspeed_tpu.parallel.topology import (  # noqa: F401
+    BATCH_AXES,
+    MESH_AXES,
+    Topology,
+    build_mesh,
+    single_device_topology,
+)

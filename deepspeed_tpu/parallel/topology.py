@@ -1,0 +1,141 @@
+"""Device mesh topology.
+
+Parity target: ``deepspeed/utils/groups.py`` (the DP/TP/PP/EP/SP process-group factory,
+:304-:916) and ``runtime/pipe/topology.py`` (``PipeDataParallelTopology``). On TPU a
+single ``jax.sharding.Mesh`` with named axes replaces all process-group bookkeeping:
+every parallel strategy is an axis name, every "group" is a mesh slice, and XLA owns
+transport (ICI intra-slice, DCN across slices) — no NCCL communicator plumbing.
+
+Axis conventions used throughout the framework:
+  ``pp``   pipeline stages (outermost; tolerates DCN latency)
+  ``dp``   pure data parallel (replicated params)
+  ``fsdp`` the ZeRO axis — param/grad/optimizer-state sharding (stages 1-3)
+  ``ep``   expert parallel
+  ``sp``   sequence/context parallel (Ulysses / ring attention)
+  ``tp``   tensor parallel (innermost; needs the fastest ICI links)
+
+The combined data-parallel world size (for batch math and grad reduction) is
+``dp * fsdp`` — matching the reference where ZeRO shards within the DP group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.utils.logging import log_dist
+
+MESH_AXES: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+# Data-parallel-like axes: the batch is sharded over these; grads are reduced over them.
+BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+
+@dataclasses.dataclass
+class Topology:
+    """A named mesh plus derived sizes. The one object engines consult for layout."""
+
+    mesh: "jax.sharding.Mesh"  # noqa: F821
+    axis_sizes: Dict[str, int]
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    @property
+    def dp_world_size(self) -> int:
+        """Batch-sharding world size (dp × fsdp), the reference's DP group size."""
+        return self.axis_sizes["dp"] * self.axis_sizes["fsdp"]
+
+    def size(self, axis: str) -> int:
+        return self.axis_sizes[axis]
+
+    @property
+    def zero_axis(self) -> str:
+        return "fsdp"
+
+    def __repr__(self) -> str:
+        axes = ", ".join(f"{k}={v}" for k, v in self.axis_sizes.items() if v > 1)
+        return f"Topology({axes or 'single-device'}, world={self.world_size})"
+
+
+def build_mesh(mesh_config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None,
+               axis_sizes: Optional[Dict[str, int]] = None) -> Topology:
+    """Construct the global :class:`Topology`.
+
+    ``axis_sizes`` overrides ``mesh_config`` for programmatic use. Multi-slice
+    (DCN-connected) topologies use ``mesh_utils.create_hybrid_device_mesh`` so the
+    outer axes (pp, dp) land on DCN and inner axes stay on ICI.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    if axis_sizes is not None:
+        sizes = {ax: int(axis_sizes.get(ax, 1)) for ax in MESH_AXES}
+        fixed = int(np.prod([v for k, v in sizes.items() if k != "dp"]))
+        if "dp" not in axis_sizes:
+            sizes["dp"] = n // fixed
+        num_slices = int(axis_sizes.get("num_slices", 1))
+    else:
+        mesh_config = mesh_config or MeshConfig()
+        sizes = {
+            "pp": mesh_config.pp,
+            "dp": mesh_config.resolved_dp(n),
+            "fsdp": mesh_config.fsdp,
+            "ep": mesh_config.ep,
+            "sp": mesh_config.sp,
+            "tp": mesh_config.tp,
+        }
+        num_slices = mesh_config.num_slices
+
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh axes {sizes} require {total} devices, have {n}")
+
+    shape = tuple(sizes[ax] for ax in MESH_AXES)
+    if num_slices > 1:
+        # Factor num_slices across the outer axes (greedily, gcd per axis) so DCN
+        # carries pp/dp and ICI carries the inner axes.
+        import math
+
+        dcn_shape: List[int] = []
+        ici_shape: List[int] = []
+        remaining_dcn = num_slices
+        for ax in MESH_AXES:
+            s = sizes[ax]
+            f = math.gcd(remaining_dcn, s)
+            dcn_shape.append(f)
+            ici_shape.append(s // f)
+            remaining_dcn //= f
+        if remaining_dcn != 1:
+            raise ValueError(
+                f"cannot factor num_slices={num_slices} across mesh axes {sizes}; "
+                f"outer axis sizes (pp, dp, ...) must jointly divide num_slices")
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            device_array = np.asarray(devices).reshape(shape)
+
+    mesh = Mesh(device_array, MESH_AXES)
+    topo = Topology(mesh=mesh, axis_sizes=sizes)
+    log_dist(f"built mesh: {topo}")
+    return topo
+
+
+def single_device_topology() -> Topology:
+    """Degenerate 1-device topology (all axes size 1)."""
+    import jax
+
+    return build_mesh(devices=jax.devices()[:1], axis_sizes={})

@@ -1,0 +1,2 @@
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401

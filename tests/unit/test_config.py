@@ -1,0 +1,96 @@
+"""Config-system tests (parity model: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedTpuConfig, from_config
+
+
+def test_defaults():
+    cfg = from_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.bf16.enabled
+    assert not cfg.fp16.enabled
+    assert cfg.precision_dtype == "bfloat16"
+
+
+def test_from_dict_and_json(tmp_path):
+    d = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+    }
+    cfg = from_config(d)
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.optimizer.params["lr"] == 1e-3
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(d))
+    cfg2 = from_config(str(p))
+    assert cfg2.model_dump() == cfg.model_dump()
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(Exception):
+        from_config({"zero_optimization": {"stagee": 2}})
+
+
+def test_invalid_stage_rejected():
+    with pytest.raises(Exception):
+        from_config({"zero_optimization": {"stage": 7}})
+
+
+@pytest.mark.parametrize(
+    "tb,mb,ga,dp,expect",
+    [
+        (32, 4, None, 4, (32, 4, 2)),
+        (32, None, 2, 4, (32, 4, 2)),
+        (None, 4, 2, 4, (32, 4, 2)),
+        (None, 4, None, 4, (16, 4, 1)),
+        (32, None, None, 4, (32, 8, 1)),
+    ],
+)
+def test_batch_triple_resolution(tb, mb, ga, dp, expect):
+    cfg = from_config({
+        "train_batch_size": tb,
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": ga,
+    })
+    cfg.resolve_batch_sizes(dp)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == expect
+
+
+def test_batch_triple_inconsistent():
+    cfg = from_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 3,
+    })
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(4)
+
+
+def test_batch_triple_missing():
+    cfg = from_config({})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(4)
+
+
+def test_auto_values():
+    cfg = from_config({"train_batch_size": "auto", "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(8)
+    assert cfg.train_batch_size == 16
+
+
+def test_mesh_config():
+    cfg = from_config({"mesh": {"tp": 2, "fsdp": 2}})
+    assert cfg.mesh.resolved_dp(8) == 2
+    with pytest.raises(ValueError):
+        cfg.mesh.resolved_dp(7)
+
+
+def test_legacy_monitor_keys():
+    cfg = from_config({"tensorboard": {"enabled": True, "output_path": "/tmp/tb"}})
+    assert cfg.monitor_config.tensorboard.enabled

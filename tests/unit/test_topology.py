@@ -1,0 +1,37 @@
+"""Mesh topology tests (parity model: tests/unit/utils/test_groups.py)."""
+
+import pytest
+
+from deepspeed_tpu.config import MeshConfig
+from deepspeed_tpu.parallel import MESH_AXES, build_mesh
+
+
+def test_default_mesh_all_dp(eight_devices):
+    topo = build_mesh()
+    assert topo.world_size == 8
+    assert topo.axis_sizes["dp"] == 8
+    assert topo.dp_world_size == 8
+    assert tuple(topo.mesh.axis_names) == MESH_AXES
+
+
+def test_mixed_axes(eight_devices):
+    topo = build_mesh(MeshConfig(tp=2, fsdp=2))
+    assert topo.axis_sizes == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert topo.dp_world_size == 4
+    assert topo.size("tp") == 2
+
+
+def test_axis_sizes_override(eight_devices):
+    topo = build_mesh(axis_sizes={"fsdp": 8})
+    assert topo.axis_sizes["fsdp"] == 8
+    assert topo.axis_sizes["dp"] == 1
+
+
+def test_indivisible_raises(eight_devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(tp=3))
+
+
+def test_explicit_dp_mismatch(eight_devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=3, tp=2))
