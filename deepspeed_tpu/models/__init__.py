@@ -1,0 +1,16 @@
+"""Model zoo for deepspeed_tpu.
+
+Parity target: the reference consumes arbitrary ``torch.nn.Module``s
+(``deepspeed/runtime/engine.py:238``) and ships reference transformer implementations
+(``deepspeed/model_implementations/``). Here the engine consumes any object satisfying
+:class:`ModelSpec`; the in-tree flagship is a decoder-only transformer family covering
+GPT-2-style and Llama-style architectures (``models/transformer.py``) plus a
+Mixtral-style MoE variant (``deepspeed_tpu/moe``).
+"""
+
+from deepspeed_tpu.models.spec import ModelSpec  # noqa: F401
+from deepspeed_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+)
+from deepspeed_tpu.models.presets import PRESETS, get_preset  # noqa: F401

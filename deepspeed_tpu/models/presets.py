@@ -1,0 +1,43 @@
+"""Named model presets covering the reference's benchmark model families
+(GPT-2 125M loss-parity target, Llama-3 8B/70B MFU targets, Mixtral-8x7B EP target —
+see BASELINE.md north stars).
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+PRESETS = {
+    # tiny configs for tests / CPU-mesh dry runs
+    "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                              num_heads=4, max_seq_len=64, arch="llama"),
+    "tiny-gpt2": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                                   num_heads=4, max_seq_len=64, arch="gpt2"),
+    "tiny-moe": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                                  num_heads=4, max_seq_len=64, arch="llama",
+                                  num_experts=4, top_k=2),
+    "gpt2-125m": TransformerConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                                   num_heads=12, max_seq_len=1024, arch="gpt2"),
+    "gpt2-1.3b": TransformerConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                                   num_heads=16, max_seq_len=2048, arch="gpt2"),
+    "llama3-1b": TransformerConfig(vocab_size=128256, hidden_size=2048, num_layers=16,
+                                   num_heads=32, num_kv_heads=8, intermediate_size=8192,
+                                   max_seq_len=8192, arch="llama", rope_theta=500000.0),
+    "llama3-8b": TransformerConfig(vocab_size=128256, hidden_size=4096, num_layers=32,
+                                   num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                                   max_seq_len=8192, arch="llama", rope_theta=500000.0),
+    "llama3-70b": TransformerConfig(vocab_size=128256, hidden_size=8192, num_layers=80,
+                                    num_heads=64, num_kv_heads=8, intermediate_size=28672,
+                                    max_seq_len=8192, arch="llama", rope_theta=500000.0),
+    "mixtral-8x7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                                      num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                                      max_seq_len=8192, arch="llama", num_experts=8,
+                                      top_k=2),
+}
+
+
+def get_preset(name: str, **overrides) -> TransformerConfig:
+    import dataclasses
+
+    cfg = PRESETS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,173 @@
+"""Sharding rules: the TPU-native replacement for ZeRO's partitioning machinery.
+
+Parity target: ``deepspeed/runtime/zero/partition_parameters.py:884`` (``zero.Init``
+flat 1-D shards), ``stage_1_and_2.py:134`` (round-robin optimizer-state partitions) and
+``module_inject/auto_tp.py:194`` (row/col tensor-parallel sharding). On TPU all of that
+collapses into ``jax.sharding.NamedSharding`` layouts over the global mesh: ZeRO stages
+decide *which pytrees* (params / grads / optimizer state) carry the ``fsdp`` axis, and
+XLA SPMD inserts + overlaps the all-gathers/reduce-scatters that the reference does with
+hooks and CUDA streams.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import Topology
+
+
+def spec_axes(entry) -> Tuple[str, ...]:
+    """Flatten one PartitionSpec dim entry to its axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def filter_spec(spec: Optional[P], axis_names: Sequence[str]) -> P:
+    """Drop mesh axes that don't exist (size-absent) from a PartitionSpec.
+
+    Lets models annotate the full (tp, sp, ...) layout while running on meshes that
+    only materialize a subset of axes.
+    """
+    if spec is None:
+        return P()
+    out = []
+    for entry in spec:
+        kept = tuple(a for a in spec_axes(entry) if a in axis_names)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def constrain(x: jax.Array, spec: Optional[P]) -> jax.Array:
+    """``with_sharding_constraint`` that is a no-op outside a mesh context.
+
+    Models call this on activations; under ``jax.sharding.use_mesh`` (the engine's jit
+    context) it pins the layout, under plain single-device execution it vanishes.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or not mesh.axis_names:
+        return x
+    fspec = filter_spec(spec, mesh.axis_names)
+    # Drop axes whose shard count exceeds the dimension size (tiny-test meshes).
+    entries = list(fspec)
+    for i, entry in enumerate(entries):
+        axes = spec_axes(entry)
+        if not axes:
+            continue
+        total = int(np.prod([mesh.shape[a] for a in axes]))
+        if i >= x.ndim or total == 0 or x.shape[i] % total != 0:
+            entries[i] = None
+    return jax.lax.with_sharding_constraint(x, P(*entries))
+
+
+def add_zero_axis(spec: Optional[P], shape: Sequence[int], zero_axis: str,
+                  zero_size: int, min_size: int = 0) -> P:
+    """Overlay the ZeRO (fsdp) axis onto a param's model-parallel spec.
+
+    Picks the largest dimension not already sharded whose size divides evenly —
+    the analog of stage3's flat 1-D partition, but kept dimension-aligned so XLA
+    emits clean all-gathers. Params smaller than ``min_size`` stay replicated
+    (``param_persistence_threshold`` parity, stage3.py).
+    """
+    if zero_size <= 1:
+        return spec if spec is not None else P()
+    nelem = int(np.prod(shape)) if shape else 0
+    if nelem < max(min_size, 2):
+        return spec if spec is not None else P()
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    used = {a for e in entries for a in spec_axes(e)}
+    if zero_axis in used:
+        return P(*entries)
+    # best = largest shardable dim
+    best, best_size = -1, 0
+    for i, dim in enumerate(shape):
+        if spec_axes(entries[i]):
+            continue  # already model-parallel sharded; avoid mixing
+        if dim % zero_size == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best < 0:
+        # fall back: co-shard with an existing axis if divisible
+        for i, dim in enumerate(shape):
+            axes = spec_axes(entries[i])
+            if axes and dim % (zero_size * 1) == 0:
+                entries[i] = tuple(axes) + (zero_axis,)
+                return P(*entries)
+        return P(*entries)  # replicated — not shardable
+    entries[best] = zero_axis
+    return P(*entries)
+
+
+def zero_param_specs(params: Any, model_specs: Any, topology: Topology,
+                     stage: int, persistence_threshold: int = 0) -> Any:
+    """Per-leaf PartitionSpec for params at rest, given the ZeRO stage.
+
+    Stage 0/1/2: params carry only model-parallel axes (replicated over dp/fsdp).
+    Stage 3:     params additionally sharded over the fsdp axis.
+    """
+    axis_names = list(topology.mesh.axis_names)
+    zero_size = topology.size(topology.zero_axis)
+
+    def one(path_leaf, spec):
+        spec = filter_spec(spec, axis_names)
+        if stage >= 3:
+            spec = add_zero_axis(spec, np.shape(path_leaf), topology.zero_axis,
+                                 zero_size, min_size=persistence_threshold)
+        return spec
+
+    if model_specs is None:
+        model_specs = jax.tree_util.tree_map(lambda _: None, params)
+    return jax.tree_util.tree_map(one, params, model_specs,
+                                  is_leaf=lambda x: x is None)
+
+
+def opt_state_specs(params: Any, param_specs: Any, topology: Topology,
+                    stage: int) -> Any:
+    """Optimizer-state layout: sharded over fsdp for stage >= 1 (ZeRO-1 semantics)."""
+    zero_size = topology.size(topology.zero_axis)
+
+    def one(leaf_shape, spec):
+        if stage >= 1:
+            return add_zero_axis(spec, leaf_shape, topology.zero_axis, zero_size)
+        return spec
+
+    return jax.tree_util.tree_map(
+        lambda p, s: one(np.shape(p), s), params, param_specs,
+        is_leaf=lambda x: x is None)
+
+
+def grad_specs(param_sharding_specs: Any, params: Any, topology: Topology,
+               stage: int) -> Any:
+    """Gradient layout: matches params for stage 3, sharded over fsdp for stage 2,
+    replicated (allreduce) for stage 0/1."""
+    if stage >= 3:
+        return param_sharding_specs
+    if stage == 2:
+        zero_size = topology.size(topology.zero_axis)
+        return jax.tree_util.tree_map(
+            lambda p, s: add_zero_axis(s, np.shape(p), topology.zero_axis, zero_size),
+            params, param_sharding_specs, is_leaf=lambda x: x is None)
+    return param_sharding_specs
+
+
+def named(topology: Topology, spec_tree: Any) -> Any:
+    """PartitionSpec tree → NamedSharding tree on this topology's mesh."""
+    mesh: Mesh = topology.mesh
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else P()),
+        spec_tree, is_leaf=lambda x: x is None or isinstance(x, P))
+
+
+def batch_spec(topology: Topology, seq_axis: bool = True) -> P:
+    """Input batch layout: batch over (dp, fsdp), sequence over sp."""
+    batch_axes = tuple(a for a in ("dp", "fsdp") if topology.axis_sizes.get(a, 1) > 1)
+    sp = "sp" if seq_axis and topology.axis_sizes.get("sp", 1) > 1 else None
+    return P(batch_axes if batch_axes else None, sp)
