@@ -9,6 +9,7 @@ engine with ``forward/backward/step`` plus data loader and LR scheduler.
 from deepspeed_tpu.version import __version__  # noqa: F401
 
 from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu import ops  # noqa: F401  (registers Pallas kernels, e.g. 'flash')
 from deepspeed_tpu.config import DeepSpeedTpuConfig, from_config  # noqa: F401
 from deepspeed_tpu.parallel import Topology, build_mesh  # noqa: F401
 

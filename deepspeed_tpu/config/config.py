@@ -95,16 +95,13 @@ class BF16Config(DSTpuConfigModel):
     immediate_grad_update: bool = True
 
 
-class OffloadDeviceEnum:
-    none = "none"
-    cpu = "cpu"
-    nvme = "nvme"
+OffloadDevice = Literal["none", "cpu", "nvme"]
 
 
 class OffloadParamConfig(DSTpuConfigModel):
     """``zero_optimization.offload_param`` (ZeRO-Infinity param offload)."""
 
-    device: str = "none"  # none|cpu|nvme
+    device: OffloadDevice = "none"
     nvme_path: Optional[str] = None
     buffer_count: int = 5
     buffer_size: int = 100_000_000
@@ -115,7 +112,7 @@ class OffloadParamConfig(DSTpuConfigModel):
 class OffloadOptimizerConfig(DSTpuConfigModel):
     """``zero_optimization.offload_optimizer`` (ZeRO-Offload / Infinity)."""
 
-    device: str = "none"  # none|cpu|nvme
+    device: OffloadDevice = "none"
     nvme_path: Optional[str] = None
     buffer_count: int = 4
     pin_memory: bool = False
@@ -313,6 +310,19 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
             if "wandb" in values:
                 values.setdefault("monitor_config", {})["wandb"] = values.pop("wandb")
         return values
+
+    @model_validator(mode="after")
+    def _precision_exclusive(self):
+        """fp16 and bf16 are mutually exclusive (reference config.py assertion).
+
+        bf16 defaults to enabled, so enabling fp16 flips the *default* bf16 off;
+        only an explicit fp16+bf16 double-enable is an error.
+        """
+        if self.fp16.enabled and self.bf16.enabled:
+            if "enabled" in self.bf16.model_fields_set:
+                raise ValueError("fp16.enabled and bf16.enabled are mutually exclusive")
+            self.bf16.enabled = False
+        return self
 
     # ---- batch triple resolution (reference config.py `_batch_assertion`) ----
     def resolve_batch_sizes(self, dp_world_size: int) -> None:

@@ -1,0 +1,8 @@
+"""Experiment monitoring fan-out.
+
+Parity target: ``deepspeed/monitor/monitor.py:30`` ``MonitorMaster`` →
+TensorBoard/W&B/CSV backends, with the ``write_events([(tag, value, step), ...])`` API
+the engine calls from its step loop (``engine.py:3406`` ``_write_monitor``).
+"""
+
+from deepspeed_tpu.monitor.monitor import MonitorMaster  # noqa: F401

@@ -1,0 +1,91 @@
+"""Monitor backends (reference: ``deepspeed/monitor/{monitor,tensorboard,csv_monitor,
+wandb}.py``). Only rank 0 writes. Backends degrade gracefully when their client
+library is absent (matching the reference's lazy imports)."""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class _Backend:
+    def write_events(self, events: Iterable[Event]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class CSVMonitor(_Backend):
+    def __init__(self, cfg):
+        self.dir = cfg.output_path or "./csv_monitor"
+        self.job = cfg.job_name
+        os.makedirs(os.path.join(self.dir, self.job), exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        for tag, value, step in events:
+            fname = os.path.join(self.dir, self.job, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", "value", "time"])
+                w.writerow([step, value, time.time()])
+
+
+class TensorBoardMonitor(_Backend):
+    def __init__(self, cfg):
+        from torch.utils.tensorboard import SummaryWriter  # torch-cpu is baked in
+
+        path = os.path.join(cfg.output_path or "./tensorboard", cfg.job_name)
+        self.writer = SummaryWriter(log_dir=path)
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(_Backend):
+    def __init__(self, cfg):
+        import wandb  # optional
+
+        wandb.init(project=cfg.project or "deepspeed_tpu", group=cfg.group,
+                   name=cfg.job_name)
+        self.wandb = wandb
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        for tag, value, step in events:
+            self.wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster:
+    """Fan-out to all enabled backends; rank-0 only (monitor.py:30 parity)."""
+
+    def __init__(self, config):
+        self.backends: List[_Backend] = []
+        import jax
+
+        self.enabled = jax.process_index() == 0
+        if not self.enabled:
+            return
+        for name, cls in (("csv_monitor", CSVMonitor),
+                          ("tensorboard", TensorBoardMonitor),
+                          ("wandb", WandbMonitor)):
+            sub = getattr(config, name)
+            if sub.enabled:
+                try:
+                    self.backends.append(cls(sub))
+                except Exception as e:  # client lib missing → log and continue
+                    logger.warning(f"monitor backend {name} unavailable: {e}")
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        if not self.enabled:
+            return
+        events = list(events)
+        for b in self.backends:
+            b.write_events(events)

@@ -103,23 +103,26 @@ def build_mesh(mesh_config: Optional[MeshConfig] = None,
 
     shape = tuple(sizes[ax] for ax in MESH_AXES)
     if num_slices > 1:
-        # Factor num_slices across the outer axes (greedily, gcd per axis) so DCN
-        # carries pp/dp and ICI carries the inner axes.
+        # Factor num_slices across the DCN-tolerant outer axes only (pp, dp, fsdp).
+        # Landing a DCN factor on ep/sp/tp would silently put per-layer collectives
+        # on the slow links — that must be a loud config error, not a slow run.
         import math
 
+        DCN_AXES = ("pp", "dp", "fsdp")
         dcn_shape: List[int] = []
         ici_shape: List[int] = []
         remaining_dcn = num_slices
         for ax in MESH_AXES:
             s = sizes[ax]
-            f = math.gcd(remaining_dcn, s)
+            f = math.gcd(remaining_dcn, s) if ax in DCN_AXES else 1
             dcn_shape.append(f)
             ici_shape.append(s // f)
             remaining_dcn //= f
         if remaining_dcn != 1:
             raise ValueError(
-                f"cannot factor num_slices={num_slices} across mesh axes {sizes}; "
-                f"outer axis sizes (pp, dp, ...) must jointly divide num_slices")
+                f"cannot factor num_slices={num_slices} across the DCN-tolerant axes "
+                f"{DCN_AXES} of mesh {sizes}; pp*dp*fsdp must be divisible by "
+                f"num_slices (ep/sp/tp are pinned to ICI)")
         device_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices)
     else:

@@ -1,0 +1,7 @@
+"""Runtime: engine, optimizers, schedules, data pipeline, checkpointing.
+
+Parity target: ``deepspeed/runtime/`` (engine.py, fp16/, zero/, lr_schedules.py,
+dataloader.py, checkpoint_engine/).
+"""
+
+from deepspeed_tpu.runtime.engine import DeepSpeedTpuEngine  # noqa: F401

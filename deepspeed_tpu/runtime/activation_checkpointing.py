@@ -1,0 +1,53 @@
+"""Activation checkpointing (rematerialization).
+
+Parity target: ``deepspeed/runtime/activation_checkpointing/checkpointing.py`` —
+``checkpoint`` (:948), ``CheckpointFunction`` (:488) with partitioned activations,
+CPU offload and RNG trackers. On TPU the whole subsystem is ``jax.checkpoint``:
+
+* ``partition_activations`` → unnecessary (saved residuals are already sharded by
+  SPMD; nothing is replicated to begin with);
+* RNG state tracking (``CudaRNGStatesTracker`` :124) → free (jax PRNG is functional);
+* CPU offload (:474) → ``policy="offload_dots"`` (XLA host-offload of saved dots);
+* the policy knob maps to ``jax.checkpoint_policies``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+_POLICY_MAP = {
+    "none": None,
+    "full": "full",
+    "dots_saveable": "dots_saveable",
+    "nothing_saveable": "nothing_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "offload_dots": "save_and_offload_only_these_names",
+}
+
+
+def configure(config) -> dict:
+    """Read the ``activation_checkpointing`` config section into remat kwargs."""
+    return {"policy": config.policy}
+
+
+def checkpoint(function: Callable, *args, policy: str = "full") -> Any:
+    """Run ``function(*args)`` under remat (reference ``checkpoint`` :948)."""
+    return checkpoint_wrapper(function, policy=policy)(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: str = "full") -> Callable:
+    if policy in (None, "none"):
+        return function
+    if policy == "full":
+        return jax.checkpoint(function)
+    if policy == "offload_dots":
+        pol = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            offload_src="device", offload_dst="pinned_host")
+        return jax.checkpoint(function, policy=pol)
+    if policy not in _POLICY_MAP:
+        raise ValueError(f"unknown remat policy '{policy}' "
+                         f"(have {sorted(_POLICY_MAP)})")
+    return jax.checkpoint(function, policy=getattr(jax.checkpoint_policies, policy))

@@ -23,10 +23,28 @@ def _synchronize() -> None:
     try:
         import jax
 
-        # block on a trivial computation enqueued after current work
-        jax.block_until_ready(jax.device_put(0))
+        jax.block_until_ready(_sync_fn()())
     except Exception:
         pass
+
+
+def _sync_fn():
+    """Cached jitted no-op — building a fresh jit per call would retrace on the
+    host hot path and skew the very timings being collected."""
+    global _SYNC_FN
+    if _SYNC_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        # Block on a trivial *computation* (not a transfer): XLA executables on a
+        # device run in enqueue order on the compute stream, so this returns only
+        # after all previously dispatched programs finish. A device_put would ride
+        # the independent transfer stream and synchronize nothing.
+        _SYNC_FN = jax.jit(lambda: jnp.zeros(()))
+    return _SYNC_FN
+
+
+_SYNC_FN = None
 
 
 class _Timer:
@@ -125,6 +143,7 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        self.steps_in_window = 0
         self.started = False
         self.start_time = 0.0
 
@@ -132,6 +151,8 @@ class ThroughputTimer:
         self.epoch_count += 1
 
     def start(self) -> None:
+        if self.started:
+            return  # one window spans all GA micro-steps; don't reset mid-window
         self.started = True
         if self.global_step_count >= self.start_step:
             _synchronize()
@@ -148,8 +169,11 @@ class ThroughputTimer:
             duration = time.perf_counter() - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
+            self.steps_in_window += 1
             if report_speed and self.global_step_count % self.steps_per_output == 0:
-                steps = self.steps_per_output
+                # divide by steps actually measured — the first window is short by
+                # start_step warmup steps
+                steps = max(self.steps_in_window, 1)
                 samples_per_sec = steps * self.batch_size / max(self.step_elapsed_time, 1e-9)
                 msg = (f"step={self.global_step_count} "
                        f"samples/sec={samples_per_sec:.2f} "
@@ -159,6 +183,7 @@ class ThroughputTimer:
                     msg += f" est_tflops={tflops:.1f}"
                 log_dist(msg)
                 self.step_elapsed_time = 0.0
+                self.steps_in_window = 0
 
     def avg_samples_per_sec(self) -> float:
         if self.total_elapsed_time <= 0:
