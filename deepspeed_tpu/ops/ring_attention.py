@@ -1,0 +1,75 @@
+"""Ring attention over a sequence-parallel mesh axis.
+
+The reference has no ring attention (its long-context members are Ulysses all-to-all
+and FPDT chunking — SURVEY.md §5.7); this is the TPU-idiomatic context-parallel
+member: KV chunks rotate around the ``sp`` ring via ``lax.ppermute`` (ICI
+neighbor exchange), each step folding a chunk into an online-softmax accumulator —
+FPDT's chunked online softmax (``sequence/fpdt_layer.py:135``) with the host-offload
+stream replaced by the ring.
+
+Call **inside** ``shard_map`` with the sequence dim sharded over ``axis``. Layout:
+q/k/v ``[B, T_local, H, d]``. Causality uses global positions, so contiguous
+(non-permuted) sequence sharding is assumed.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, scale):
+    return jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
+                   causal: bool = True) -> jax.Array:
+    """Exact attention over the full (ring-distributed) sequence."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, d = q.shape
+    K = k.shape[2]
+    if K != H:  # GQA: expand once, locally
+        rep = H // K
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * Tl + jnp.arange(Tl)  # global positions of local queries
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - s) % n  # rank whose kv chunk we currently hold
+        kv_pos = src * Tl + jnp.arange(Tl)
+        scores = _chunk_scores(q, k_cur, scale)  # [B, H, Tl, Tl]
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhts,bshd->bthd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * corr.transpose(0, 2, 1, 3) + pv
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    # mark the fresh accumulators as device-varying over the ring axis so the scan
+    # carry type matches the computed updates (shard_map vma check)
+    m0 = lax.pvary(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis)
+    l0 = lax.pvary(jnp.zeros((B, H, Tl, 1), jnp.float32), axis)
+    acc0 = lax.pvary(jnp.zeros((B, Tl, H, d), jnp.float32), axis)
+    (k_f, v_f, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)  # [B, Tl, H, 1]
+    return (acc / denom).astype(q.dtype)

@@ -1,0 +1,129 @@
+"""Op numeric-parity tests (pattern: reference ``tests/unit/ops/`` — each custom
+kernel vs a plain reference implementation). Pallas kernels run in interpret mode on
+the CPU mesh; real-TPU parity is exercised by the verify drive."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.quantization import (
+    dequantize_blockwise, dequantize_fp8, quantize_blockwise, quantize_fp8,
+)
+from deepspeed_tpu.ops.rms_norm import fused_rms_norm
+
+
+def _qkv(T=64, S=64, H=4, K=4, d=16, dtype=jnp.float32):
+    q = jax.random.normal(jax.random.key(1), (1, T, H, d), dtype)
+    k = jax.random.normal(jax.random.key(2), (1, S, K, d), dtype)
+    v = jax.random.normal(jax.random.key(3), (1, S, K, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_forward_parity_causal(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_forward_gqa(self):
+        q, k, v = _qkv(H=8, K=2)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_noncausal(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=False, interpret=True)
+        ref = xla_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_backward_parity(self):
+        q, k, v = _qkv(T=32, S=32)
+
+        def f_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True, interpret=True).sum()
+
+        def f_ref(q, k, v):
+            return xla_attention(q, k, v, causal=True).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+    def test_backward_gqa(self):
+        q, k, v = _qkv(T=32, S=32, H=4, K=2)
+        g1 = jax.grad(lambda k: flash_attention(q, k, v, interpret=True).sum())(k)
+        g2 = jax.grad(lambda k: xla_attention(q, k, v).sum())(k)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-4)
+
+    def test_uneven_block_sizes(self):
+        # T=48 not divisible by default blocks → _pick_block must adapt
+        q, k, v = _qkv(T=48, S=48)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestRMSNorm:
+    def test_parity(self):
+        x = jax.random.normal(jax.random.key(4), (4, 32, 64))
+        w = jax.random.normal(jax.random.key(5), (64,)) + 1.0
+        ref = np.asarray(x) / np.sqrt(
+            (np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-5) * np.asarray(w)
+        np.testing.assert_allclose(np.asarray(fused_rms_norm(x, w)), ref, atol=2e-5)
+
+    def test_grad_parity(self):
+        x = jax.random.normal(jax.random.key(6), (8, 64))
+        w = jax.random.normal(jax.random.key(7), (64,)) + 1.0
+
+        def ref_fn(x, w):
+            inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-5)
+            return (x * inv * w).sum()
+
+        g1 = jax.grad(lambda x, w: fused_rms_norm(x, w).sum(), argnums=(0, 1))(x, w)
+        g2 = jax.grad(ref_fn, argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+class TestQuantization:
+    @pytest.mark.parametrize("bits,tol", [(8, 0.02), (4, 0.35)])
+    def test_roundtrip(self, bits, tol):
+        x = np.random.default_rng(0).normal(size=(4096,)).astype(np.float32)
+        q, s = quantize_blockwise(x, bits=bits, group_size=512)
+        d = np.asarray(dequantize_blockwise(q, s, bits=bits, shape=x.shape,
+                                            dtype=jnp.float32))
+        assert np.abs(d - x).max() < tol
+        if bits == 8:
+            assert q.dtype == jnp.int8 and q.size == x.size
+        else:
+            assert q.size == x.size // 2  # packed nibbles
+
+    def test_fp8_roundtrip(self):
+        x = np.random.default_rng(1).normal(size=(1024,)).astype(np.float32) * 10
+        q, s = quantize_fp8(jnp.asarray(x))
+        d = np.asarray(dequantize_fp8(q, s, dtype=jnp.float32))
+        rel = np.abs(d - x) / (np.abs(x) + 1e-3)
+        assert np.median(rel) < 0.05
+
+
+def test_op_registry():
+    from deepspeed_tpu.ops import ALL_OPS, get_op_builder, op_report
+
+    assert "flash_attn" in ALL_OPS
+    fn = get_op_builder("flash_attn").load()
+    assert callable(fn)
+    assert all(isinstance(ok, bool) for _, ok in op_report())
+
+
+def test_attention_registry_has_flash():
+    from deepspeed_tpu.models.transformer import _ATTENTION_IMPLS
+
+    import deepspeed_tpu  # noqa: F401  (import registers)
+
+    assert "flash" in _ATTENTION_IMPLS
