@@ -1,0 +1,12 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity target: ``deepspeed/moe/`` — ``MoE`` (layer.py:17), ``MOELayer``/``TopKGate``
+(sharded_moe.py:536/:452), gating fns (:184-:450), ``_AllToAll`` dispatch (:97), and
+the EP group algebra of ``utils/groups.py:304``. On TPU the expert dimension is the
+``ep`` mesh axis: dispatch/combine are einsums whose operands carry ``ep`` sharding
+constraints, so XLA SPMD emits the same all-to-alls the reference issues manually.
+"""
+
+from deepspeed_tpu.moe.sharded_moe import (  # noqa: F401
+    MoE, moe_mlp_block, top1_gating, topk_gating,
+)

@@ -1,0 +1,137 @@
+"""Top-k gating + capacity-based expert dispatch (GShard algebra).
+
+Parity target: ``deepspeed/moe/sharded_moe.py`` — ``top1gating`` :184, ``top2gating``
+:291, ``topkgating`` :375, ``TopKGate`` :452, ``MOELayer`` :536. The torch version
+builds dispatch/combine masks then calls ``_AllToAll`` over the EP process group; here
+the masks feed einsums and the ``[E, C, D]`` dispatched tensor is sharding-constrained
+to the ``ep`` axis — the all-to-all is XLA's, riding ICI.
+
+Static-shape discipline: capacity ``C`` is computed from *static* sequence length and
+capacity factor, so the whole layer jits with fixed shapes (no ragged dispatch in the
+hot path; dropped tokens pass through the residual, exactly like the reference with
+``drop_tokens=True``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.sharding import constrain
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
+                min_capacity: int = 4, rng: Optional[jax.Array] = None,
+                noise_std: float = 0.0
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """GShard top-k gating with per-expert capacity.
+
+    Args:
+        logits: [S, E] raw router outputs (fp32 recommended).
+    Returns:
+        (dispatch [S, E, C] float, combine [S, E, C] float, aux_loss scalar, stats)
+    """
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor, min_capacity)
+    logits = logits.astype(jnp.float32)
+    if noise_std > 0.0 and rng is not None:  # noisy_gate_policy='RSample' parity
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+    gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
+
+    # aux load-balancing loss on the top-1 assignment (sharded_moe.py:184 l_aux)
+    top1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(top1, E, dtype=jnp.float32)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
+    # renormalize the kept gate mass (reference normalizes combine weights)
+    denom = jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
+    topk_vals = topk_vals / denom
+
+    dispatch = jnp.zeros((S, E, C), jnp.float32)
+    combine = jnp.zeros((S, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)  # tokens already assigned per expert
+    for j in range(k):
+        idx_j = topk_idx[:, j]                       # [S]
+        mask_j = jax.nn.one_hot(idx_j, E, dtype=jnp.int32)   # [S, E]
+        pos_in_expert = jnp.cumsum(mask_j, axis=0) - mask_j  # position among j-th picks
+        loc = jnp.sum(pos_in_expert * mask_j, axis=1) + counts[idx_j]  # [S]
+        keep = loc < C
+        counts = counts + jnp.sum(mask_j * keep[:, None].astype(jnp.int32), axis=0)
+        onehot_loc = jax.nn.one_hot(loc, C, dtype=jnp.float32) * keep[:, None]
+        sel = mask_j.astype(jnp.float32)[:, :, None] * onehot_loc[:, None, :]  # [S,E,C]
+        dispatch = dispatch + sel
+        combine = combine + sel * topk_vals[:, j][:, None, None]
+
+    stats = {"capacity": jnp.asarray(C), "tokens_per_expert": counts,
+             "drop_fraction": 1.0 - dispatch.sum() / (S * k)}
+    return dispatch, combine, aux_loss, stats
+
+
+def top1_gating(logits: jax.Array, **kw):
+    """``top1gating`` parity (switch-transformer routing)."""
+    return topk_gating(logits, k=1, **kw)
+
+
+def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in MoE MLP for ``TransformerLM`` (the ``moe_fn`` hook in
+    ``models/transformer.py`` ``transformer_block``).
+
+    h: [B, T, D]; w: router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D].
+    """
+    B, T, D = h.shape
+    E = w["router"].shape[-1]
+    x = h.reshape(B * T, D)
+    logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)
+    dispatch, combine, aux, _ = topk_gating(
+        logits, k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+        min_capacity=getattr(cfg, "min_capacity", 4))
+
+    dt = h.dtype
+    xe = jnp.einsum("sec,sd->ecd", dispatch.astype(dt), x)       # [E, C, D]
+    xe = constrain(xe, P("ep", None, None))
+    if "w_gate" in w:
+        act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w["w_gate"]))
+        act = act * jnp.einsum("ecd,edf->ecf", xe, w["w_up"])
+    else:
+        act = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w["w_up"]), approximate=True)
+    act = constrain(act, P("ep", None, "tp"))
+    ye = jnp.einsum("ecf,efd->ecd", act, w["w_down"])            # [E, C, D]
+    ye = constrain(ye, P("ep", None, None))
+    y = jnp.einsum("sec,ecd->sd", combine.astype(dt), ye)
+    return y.reshape(B, T, D), aux
+
+
+class MoE:
+    """Layer-shaped parity wrapper (``deepspeed.moe.layer.MoE`` layer.py:17)."""
+
+    def __init__(self, hidden_size: int, num_experts: int = 1, k: int = 2,
+                 capacity_factor: float = 1.25, eval_capacity_factor: float = 2.0,
+                 min_capacity: int = 4, drop_tokens: bool = True,
+                 noisy_gate_policy: Optional[str] = None, **_):
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.min_capacity = min_capacity
+
+    def __call__(self, h: jax.Array, w: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
+        class _Cfg:
+            top_k = self.k
+            capacity_factor = self.capacity_factor
+            min_capacity = self.min_capacity
+
+        return moe_mlp_block(h, w, _Cfg())

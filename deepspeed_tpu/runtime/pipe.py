@@ -1,0 +1,171 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+Parity target: ``deepspeed/runtime/pipe/`` — ``PipelineModule`` (module.py:698 layer
+partitioning) + ``PipelineEngine``/``TrainSchedule`` (engine.py:60, schedule.py:189
+1F1B with explicit P2P sends). TPU-native design:
+
+* layer partitioning = sharding the **stacked layer axis** of the transformer params
+  over ``pp`` (each stage holds ``L/pp`` contiguous layers — the ``partition_method=
+  "uniform"`` policy; the reference's parameter-balanced policy is unnecessary because
+  decoder blocks are homogeneous);
+* P2P sends = ``lax.ppermute`` neighbor rotation inside a ``shard_map`` that is
+  **manual over pp only** — dp/fsdp/tp/sp stay on XLA auto-SPMD, so ZeRO and TP
+  compose with the pipeline untouched;
+* schedule = GPipe loop of ``M + pp - 1`` ticks expressed as ``lax.scan``; the
+  backward pass is plain autodiff through the scan (reverse rotation), with
+  per-microbatch ``jax.checkpoint`` giving the 1F1B-equivalent activation footprint
+  (one stage's live activations ≈ in-flight microbatches, not the whole batch);
+* tied embedding gradients (``ReduceTiedGrads`` pipe/engine.py:274) come out of
+  autodiff's psum for pp-replicated params — no special handling.
+
+``PipelineModule`` wraps a ``TransformerLM`` and satisfies the same ModelSpec
+protocol, so the unmodified engine trains it; ``initialize()`` auto-wraps when the
+mesh has ``pp > 1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.transformer import (
+    TransformerLM, get_attention_impl, lm_loss, transformer_block, _norm,
+)
+
+
+class PipelineModule:
+    """ModelSpec wrapper running the inner model's layer stack as a pipeline."""
+
+    def __init__(self, model: TransformerLM, num_stages: int,
+                 micro_batches: Optional[int] = None,
+                 activation_checkpointing: bool = True):
+        if model.cfg.num_layers % num_stages != 0:
+            raise ValueError(f"num_layers={model.cfg.num_layers} not divisible by "
+                             f"pipeline stages={num_stages}")
+        self.model = model
+        self.cfg = model.cfg
+        self.num_stages = num_stages
+        self.micro_batches = micro_batches or num_stages
+        self.remat = activation_checkpointing
+
+    def init(self, rng):
+        return self.model.init(rng)
+
+    def param_specs(self):
+        """Inner specs + ``pp`` on the stacked layer axis (stage partitioning)."""
+        specs = self.model.param_specs()
+
+        def add_pp(spec):
+            entries = list(spec) if spec is not None else []
+            first = entries[0] if entries else None
+            axes = ((first,) if isinstance(first, str)
+                    else tuple(first) if first else ())
+            entries = [tuple(("pp",) + axes) if len(axes) else "pp"] + entries[1:]
+            return P(*entries)
+
+        specs["layers"] = jax.tree_util.tree_map(
+            add_pp, specs["layers"], is_leaf=lambda x: x is None or isinstance(x, P))
+        return specs
+
+    # ------------------------------------------------------------------
+    def loss_fn(self, params, batch, rng=None):
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty or "pp" not in mesh.axis_names:
+            raise RuntimeError("PipelineModule.loss_fn requires a mesh context with a "
+                               "'pp' axis (run under the engine)")
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(), params, is_leaf=lambda x: x is None)
+        param_specs["layers"] = jax.tree_util.tree_map(
+            lambda _: P("pp"), params["layers"])
+        batch_specs = jax.tree_util.tree_map(lambda _: P(), batch)
+        fn = jax.shard_map(self._local_loss, mesh=mesh,
+                           in_specs=(param_specs, batch_specs),
+                           out_specs=P(), axis_names={"pp"})
+        return fn(params, batch)
+
+    def _local_loss(self, params, batch):
+        cfg = self.cfg
+        if (jnp.dtype(cfg.dtype) == jnp.bfloat16
+                and jax.default_backend() == "cpu"):
+            # XLA:CPU check-fails ("invalid binary instruction opcode copy") when
+            # partitioning the *gradient* of a bf16 ppermute pipeline; fp32 is
+            # correct there. TPU (the real target) runs bf16 as configured.
+            cfg = dataclasses.replace(cfg, dtype="float32")
+        n = lax.axis_size("pp")
+        idx = lax.axis_index("pp")
+        M = self.micro_batches
+        dt = jnp.dtype(cfg.dtype)
+        attn_fn = get_attention_impl(cfg.attention_impl)
+        freqs = self.model._freqs
+
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        while B % M != 0:
+            M -= 1
+        mb = B // M
+
+        # embedding (computed on every stage; only stage 0's result is consumed)
+        x = params["embed"]["tokens"].astype(dt)[ids]
+        if cfg.learned_pos:
+            x = x + params["embed"]["pos"][:T].astype(dt)
+        x_mb = x.reshape(M, mb, T, -1)
+
+        def stage_fn(layers_local, h):
+            def body(carry, layer_w):
+                y, aux = transformer_block(carry, layer_w, cfg, freqs, attn_fn)
+                return y, aux
+
+            h, _ = lax.scan(body, h, layers_local)
+            return h
+
+        if self.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        state = lax.pvary(jnp.zeros((mb, T, x.shape[-1]), x.dtype), "pp")
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        # GPipe schedule, unrolled over the (static) M + n - 1 ticks. Unrolling
+        # keeps every schedule index static — XLA sees a straight-line program of
+        # collective_permutes it can pipeline (a scan-of-ppermute compiles
+        # pathologically on some backends and hides nothing: the tick count is
+        # compile-time anyway, exactly like the reference's instruction list
+        # (schedule.py:189 yields a static 1F1B instruction sequence)).
+        collected = []
+        for t in range(M + n - 1):
+            inject = x_mb[min(t, M - 1)]
+            cur = jnp.where(idx == 0, inject, state)
+            out = stage_fn(params["layers"], cur)
+            if t >= n - 1:
+                collected.append(out)
+            if t < M + n - 2:
+                state = lax.ppermute(out, "pp", perm)
+        outputs = jnp.stack(collected)  # [M, mb, T, D] (valid on the last stage)
+
+        # last stage: final norm + logits + loss over the reassembled batch
+        h = outputs.reshape(B, T, -1)
+        h = _norm(h, params["final_norm"], cfg.norm, cfg.norm_eps)
+        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = h @ head.astype(dt)
+        loss = lm_loss(cfg, logits, batch)
+        # only the last stage holds real outputs; broadcast its loss
+        return lax.psum(jnp.where(idx == n - 1, loss, 0.0), "pp")
+
+
+def maybe_wrap_pipeline(model, config, topology):
+    """Auto-wrap for ``initialize()`` when the mesh has pp > 1."""
+    pp = topology.axis_sizes.get("pp", 1)
+    if pp <= 1 or isinstance(model, PipelineModule):
+        return model
+    if not isinstance(model, TransformerLM):
+        raise ValueError("pipeline parallelism requires a TransformerLM (or wrap "
+                         "your model in PipelineModule yourself)")
+    micro = config.pipeline.micro_batches
+    micro = None if micro in (None, "auto") else int(micro)
+    return PipelineModule(model, pp, micro_batches=micro)

@@ -1,0 +1,11 @@
+"""Sequence/context parallelism (long-context training).
+
+Parity targets: ``deepspeed/sequence/layer.py`` (Ulysses), ``runtime/sequence_parallel/
+ulysses_sp.py`` (ALST: dataloader sharding + tiled compute), ``sequence/fpdt_layer.py``
+(chunked offload attention → subsumed by ring attention on TPU).
+"""
+
+from deepspeed_tpu.sequence.layer import DistributedAttention, ulysses_attention  # noqa: F401
+from deepspeed_tpu.sequence.tiling import (  # noqa: F401
+    TiledMLP, sequence_tiled_compute, tiled_logits_loss,
+)

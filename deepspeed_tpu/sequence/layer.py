@@ -1,0 +1,59 @@
+"""Ulysses sequence parallelism: all-to-all head-scatter / seq-gather.
+
+Parity target: ``deepspeed/sequence/layer.py`` — ``DistributedAttention`` (:351) and
+``_SeqAllToAll`` (:297). The torch version shuffles per-head tensors through process
+groups; on TPU each a2a is one ``lax.all_to_all`` on the ``sp`` mesh axis riding ICI.
+Constraint (same as reference :246-255): heads must divide the sp axis size — ring
+attention (``ops/ring_attention.py``) covers the GQA/few-heads regime.
+
+Call inside ``shard_map`` with sequence sharded over ``axis``:
+  q/k/v: [B, T/sp, H, d]  →(a2a)→  [B, T, H/sp, d]  →attn→  →(a2a)→  [B, T/sp, H, d]
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_all_to_all(x: jax.Array, axis: str, scatter_dim: int, gather_dim: int
+                   ) -> jax.Array:
+    """reference ``_SeqAllToAll.apply`` (sequence/layer.py:297)."""
+    return lax.all_to_all(x, axis, split_axis=scatter_dim, concat_axis=gather_dim,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
+                      attn_fn: Optional[Callable] = None, causal: bool = True
+                      ) -> jax.Array:
+    """Full-sequence attention with heads sharded over ``axis``."""
+    if attn_fn is None:
+        from deepspeed_tpu.models.transformer import get_attention_impl
+
+        attn_fn = get_attention_impl("auto")
+    # scatter heads (dim 2), gather sequence (dim 1)
+    q_full = seq_all_to_all(q, axis, 2, 1)
+    k_full = seq_all_to_all(k, axis, 2, 1)
+    v_full = seq_all_to_all(v, axis, 2, 1)
+    out = attn_fn(q_full, k_full, v_full, causal=causal)
+    # scatter sequence back, gather heads
+    return seq_all_to_all(out, axis, 1, 2)
+
+
+class DistributedAttention:
+    """Class-shaped parity wrapper (``DistributedAttention`` sequence/layer.py:351)."""
+
+    def __init__(self, local_attention: Optional[Callable] = None,
+                 sequence_process_group: str = "sp", scatter_idx: int = 2,
+                 gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis = sequence_process_group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, causal: bool = True, **kwargs):
+        return ulysses_attention(query, key, value, axis=self.axis,
+                                 attn_fn=self.local_attn, causal=causal)

@@ -1,0 +1,116 @@
+"""Sequence-parallel tests: Ulysses and ring attention inside shard_map on the
+virtual 8-device mesh must match single-device full attention (pattern: the
+reference's Ulysses tests exercise ``DistributedAttention`` over real process
+groups)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.ops.ring_attention import ring_attention
+from deepspeed_tpu.sequence import DistributedAttention, ulysses_attention
+from deepspeed_tpu.sequence.tiling import sequence_tiled_compute, tiled_logits_loss
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(eight_devices):
+    return Mesh(np.array(eight_devices[:4]), ("sp",))
+
+
+def _qkv(T=64, H=4, K=4, d=16):
+    q = jax.random.normal(jax.random.key(1), (2, T, H, d))
+    k = jax.random.normal(jax.random.key(2), (2, T, K, d))
+    v = jax.random.normal(jax.random.key(3), (2, T, K, d))
+    return q, k, v
+
+
+def _run_sp(mesh, fn, q, k, v):
+    spec = P(None, "sp", None, None)
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)
+    return sharded(q, k, v)
+
+
+def test_ulysses_matches_full(sp_mesh):
+    q, k, v = _qkv()
+    out = _run_sp(sp_mesh, lambda q, k, v: ulysses_attention(q, k, v, axis="sp"),
+                  q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_grad(sp_mesh):
+    q, k, v = _qkv(T=32)
+
+    def loss_sp(q, k, v):
+        return _run_sp(sp_mesh,
+                       lambda q, k, v: ulysses_attention(q, k, v, axis="sp"),
+                       q, k, v).sum()
+
+    g1 = jax.grad(loss_sp)(q, k, v)
+    g2 = jax.grad(lambda q: xla_attention(q, k, v).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-4)
+
+
+def test_distributed_attention_wrapper(sp_mesh):
+    q, k, v = _qkv()
+    da = DistributedAttention(sequence_process_group="sp")
+    out = _run_sp(sp_mesh, lambda q, k, v: da(q, k, v), q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_matches_full(sp_mesh):
+    q, k, v = _qkv()
+    out = _run_sp(sp_mesh, lambda q, k, v: ring_attention(q, k, v, axis="sp"),
+                  q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gqa(sp_mesh):
+    q, k, v = _qkv(H=8, K=2)
+    out = _run_sp(sp_mesh, lambda q, k, v: ring_attention(q, k, v, axis="sp"),
+                  q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_grad(sp_mesh):
+    q, k, v = _qkv(T=32)
+
+    def loss_sp(q):
+        return _run_sp(sp_mesh,
+                       lambda q, k, v: ring_attention(q, k, v, axis="sp"),
+                       q, k, v).sum()
+
+    g1 = jax.grad(loss_sp)(q)
+    g2 = jax.grad(lambda q: xla_attention(q, k, v).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-4)
+
+
+def test_sequence_tiled_compute():
+    x = jax.random.normal(jax.random.key(0), (2, 32, 16))
+    fn = lambda c: jax.nn.gelu(c) * 2.0
+    np.testing.assert_allclose(
+        np.asarray(sequence_tiled_compute(fn, x, num_shards=4)),
+        np.asarray(fn(x)), atol=1e-6)
+
+
+def test_tiled_logits_loss_matches_dense():
+    B, T, D, V = 2, 32, 16, 64
+    h = jax.random.normal(jax.random.key(1), (B, T, D))
+    head = jax.random.normal(jax.random.key(2), (D, V))
+    labels = np.random.default_rng(0).integers(0, V, (B, T))
+    labels[0, :5] = -100
+    tiled = tiled_logits_loss(h, head, jnp.asarray(labels), num_shards=4)
+    logits = (h @ head).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    mask = labels != -100
+    gold = np.take_along_axis(np.asarray(logits), np.maximum(labels, 0)[..., None],
+                              axis=-1)[..., 0]
+    ref = ((np.asarray(logz) - gold) * mask).sum() / mask.sum()
+    np.testing.assert_allclose(float(tiled), ref, rtol=1e-5)
