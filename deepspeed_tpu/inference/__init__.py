@@ -1,0 +1,10 @@
+"""Inference stack.
+
+Parity targets: ``deepspeed/inference/engine.py`` (v1 engine: TP-sharded forward,
+generation) and ``deepspeed/inference/v2/`` (FastGen: continuous batching, blocked KV
+allocator, ragged step).
+"""
+
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2  # noqa: F401
+from deepspeed_tpu.inference.ragged import BlockedAllocator, SequenceManager  # noqa: F401

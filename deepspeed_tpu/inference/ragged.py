@@ -1,0 +1,107 @@
+"""Ragged/continuous-batching state management.
+
+Parity target: ``deepspeed/inference/v2/ragged/`` — ``BlockedAllocator``
+(blocked_allocator.py: free-list of fixed-size KV blocks), ``DSStateManager``
+(ragged_manager.py:19: per-sequence descriptors, scheduling queries) and the host-side
+ragged batch metadata (``ragged_wrapper.py``). These are host-side Python (the
+reference keeps them in C++ for speed; descriptor math here is trivially cheap next to
+a TPU step, so Python is the right tool — the device-side layout work lives in the
+paged attention kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Fixed-size block free-list (blocked_allocator.py parity)."""
+
+    def __init__(self, num_blocks: int, block_size: int = 128):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"out of KV blocks: want {n}, have {len(self._free)}")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        self._free.extend(blocks)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Per-sequence state (ragged_manager.py sequence descriptor parity)."""
+
+    uid: int
+    slot: int                      # dense tile row while scheduled
+    seen_tokens: int = 0           # tokens already in KV
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    in_flight: int = 0
+
+
+class SequenceManager:
+    """Tracks live sequences and KV capacity; answers schedulability queries
+    (``DSStateManager`` ragged_manager.py:19 / ``can_schedule`` engine_v2.py:184)."""
+
+    def __init__(self, max_sequences: int, max_seq_len: int, block_size: int = 128,
+                 num_blocks: Optional[int] = None):
+        self.max_sequences = max_sequences
+        self.max_seq_len = max_seq_len
+        self.allocator = BlockedAllocator(
+            num_blocks if num_blocks is not None
+            else max_sequences * ((max_seq_len + block_size - 1) // block_size),
+            block_size)
+        self.sequences: Dict[int, SequenceDescriptor] = {}
+        self._free_slots = list(range(max_sequences))
+
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid in self.sequences:
+            return self.sequences[uid]
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots; flush finished sequences")
+        seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
+        self.sequences[uid] = seq
+        return seq
+
+    def can_schedule(self, uid: int, new_tokens: int) -> bool:
+        seq = self.sequences.get(uid)
+        have = len(seq.blocks) * self.allocator.block_size if seq else 0
+        seen = seq.seen_tokens if seq else 0
+        if seen + new_tokens > self.max_seq_len:
+            return False
+        need_blocks = max(
+            0, -(-(seen + new_tokens) // self.allocator.block_size)
+            - (len(seq.blocks) if seq else 0))
+        slots_ok = uid in self.sequences or bool(self._free_slots)
+        return slots_ok and need_blocks <= self.allocator.free_blocks
+
+    def schedule(self, uid: int, new_tokens: int) -> SequenceDescriptor:
+        seq = self.get_or_create(uid)
+        needed = -(-(seq.seen_tokens + new_tokens) // self.allocator.block_size)
+        if needed > len(seq.blocks):
+            seq.blocks.extend(self.allocator.allocate(needed - len(seq.blocks)))
+        seq.in_flight = new_tokens
+        return seq
+
+    def commit(self, uid: int) -> None:
+        seq = self.sequences[uid]
+        seq.seen_tokens += seq.in_flight
+        seq.in_flight = 0
+
+    def flush(self, uid: int) -> None:
+        """Release a finished sequence (engine ``flush`` parity)."""
+        seq = self.sequences.pop(uid, None)
+        if seq is not None:
+            self.allocator.free(seq.blocks)
+            self._free_slots.append(seq.slot)
