@@ -1,0 +1,146 @@
+// Async tensor file I/O with a worker threadpool (DeepNVMe parity).
+//
+// Parity target: reference csrc/aio/ — deepspeed_py_io_handle.cpp (handle API:
+// async_pread/async_pwrite/wait), deepspeed_aio_thread.cpp (worker threadpool),
+// deepspeed_pin_tensor.cpp (pinned buffer pool). The reference rides libaio/io_uring
+// for O_DIRECT NVMe queues; this implementation uses a pread/pwrite threadpool —
+// on TPU-VM local SSD (and gcsfuse) the page cache + parallel threads saturate the
+// device, and the handle semantics (submit N, overlap with compute, wait) are
+// identical. O_DIRECT is honored when block-aligned.
+//
+// C ABI for ctypes. A handle owns a queue + worker threads; ops complete in
+// submission order per worker but arbitrary order globally (same as reference).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Op {
+  enum Kind { READ, WRITE } kind;
+  std::string path;
+  void* buf;
+  int64_t nbytes;
+  int64_t file_offset;
+  bool o_direct;
+};
+
+struct Handle {
+  std::vector<std::thread> workers;
+  std::deque<Op> queue;
+  std::mutex mu;
+  std::condition_variable cv_submit;
+  std::condition_variable cv_done;
+  std::atomic<int64_t> inflight{0};
+  std::atomic<int64_t> errors{0};
+  bool stop = false;
+
+  void worker_loop() {
+    for (;;) {
+      Op op;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_submit.wait(lk, [&] { return stop || !queue.empty(); });
+        if (stop && queue.empty()) return;
+        op = queue.front();
+        queue.pop_front();
+      }
+      if (run_op(op) != 0) errors.fetch_add(1);
+      if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+    }
+  }
+
+  static int run_op(const Op& op) {
+    int flags = (op.kind == Op::READ) ? O_RDONLY : (O_WRONLY | O_CREAT);
+    if (op.o_direct) flags |= O_DIRECT;
+    int fd = ::open(op.path.c_str(), flags, 0644);
+    if (fd < 0 && op.o_direct) {  // fs may not support O_DIRECT; retry buffered
+      flags &= ~O_DIRECT;
+      fd = ::open(op.path.c_str(), flags, 0644);
+    }
+    if (fd < 0) return -1;
+    char* p = (char*)op.buf;
+    int64_t remaining = op.nbytes;
+    int64_t off = op.file_offset;
+    while (remaining > 0) {
+      ssize_t n = (op.kind == Op::READ) ? ::pread(fd, p, remaining, off)
+                                        : ::pwrite(fd, p, remaining, off);
+      if (n <= 0) { ::close(fd); return -1; }
+      p += n; off += n; remaining -= n;
+    }
+    if (op.kind == Op::WRITE) ::fdatasync(fd);
+    ::close(fd);
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_handle_create(int num_threads) {
+  auto* h = new Handle();
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    h->workers.emplace_back([h] { h->worker_loop(); });
+  return h;
+}
+
+void ds_aio_handle_destroy(void* handle) {
+  auto* h = (Handle*)handle;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->stop = true;
+  }
+  h->cv_submit.notify_all();
+  for (auto& t : h->workers) t.join();
+  delete h;
+}
+
+static void submit(Handle* h, Op op) {
+  h->inflight.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->queue.push_back(std::move(op));
+  }
+  h->cv_submit.notify_one();
+}
+
+// async_pwrite (deepspeed_py_io_handle.cpp parity): buffer must stay alive
+// until ds_aio_wait returns 0 pending.
+void ds_aio_pwrite(void* handle, const char* path, void* buf, int64_t nbytes,
+                   int64_t file_offset, int o_direct) {
+  submit((Handle*)handle, Op{Op::WRITE, path, buf, nbytes, file_offset,
+                             o_direct != 0});
+}
+
+void ds_aio_pread(void* handle, const char* path, void* buf, int64_t nbytes,
+                  int64_t file_offset, int o_direct) {
+  submit((Handle*)handle, Op{Op::READ, path, buf, nbytes, file_offset,
+                             o_direct != 0});
+}
+
+// Block until every submitted op completes; returns the error count since the
+// last wait (reference handle.wait() semantics).
+int64_t ds_aio_wait(void* handle) {
+  auto* h = (Handle*)handle;
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->cv_done.wait(lk, [&] { return h->inflight.load() == 0; });
+  return h->errors.exchange(0);
+}
+
+int64_t ds_aio_pending(void* handle) {
+  return ((Handle*)handle)->inflight.load();
+}
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+"""Host/NVMe offload tier (ZeRO-Offload / ZeRO-Infinity).
+
+Parity targets: ``deepspeed/ops/adam/cpu_adam.py`` + ``csrc/adam/cpu_adam_impl.cpp``
+(host optimizer), ``deepspeed/runtime/swap_tensor/`` + ``csrc/aio`` (NVMe tensor
+swapping). The engine routes its optimizer step here when
+``zero_optimization.offload_optimizer.device`` is ``cpu`` or ``nvme``.
+"""
+
+from deepspeed_tpu.offload.cpu_adam import DeepSpeedCPUAdam  # noqa: F401
+from deepspeed_tpu.offload.swap import AsyncTensorSwapper  # noqa: F401
+from deepspeed_tpu.offload.optimizer import HostOffloadOptimizer  # noqa: F401

@@ -1,0 +1,54 @@
+"""Host Adam/Adagrad/Lion over the native C++ kernels.
+
+Parity target: ``deepspeed/ops/adam/cpu_adam.py`` ``DeepSpeedCPUAdam`` — fp32 master
+weights + moments live in host RAM, updated by the vectorized native loop
+(csrc/cpu_adam.cpp here; csrc/adam/cpu_adam_impl.cpp in the reference).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_f32p)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW over flat host fp32 buffers (one instance per engine)."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True):
+        self.lib = CPUAdamBuilder().load()
+        self.lib.ds_adam_step.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             exp_avg_sq: np.ndarray, lr: Optional[float] = None,
+             increment: bool = True) -> None:
+        """In-place fused update of one flat fp32 shard."""
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        if increment:
+            self.step_count += 1
+        self.lib.ds_adam_step(
+            _ptr(params), _ptr(np.ascontiguousarray(grads, np.float32)),
+            _ptr(exp_avg), _ptr(exp_avg_sq), params.size,
+            ctypes.c_float(self.lr if lr is None else lr),
+            ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+            1 if self.adamw_mode else 0, self.step_count)

@@ -1,0 +1,154 @@
+"""Host-offloaded optimizer step (ZeRO-Offload) with optional NVMe state tier
+(ZeRO-Infinity).
+
+Parity target: ``runtime/zero/stage_1_and_2.py``/``stage3.py`` with
+``offload_optimizer.device=cpu|nvme`` + ``swap_tensor/partitioned_optimizer_swapper``:
+fp32 master weights and Adam moments live in host RAM (or NVMe files), the update runs
+in the native C++ loop, and only the compute-dtype params travel back to HBM. The
+engine routes ``step()`` here instead of the jitted optax apply.
+
+NVMe pipelining mirrors ``pipelined_optimizer_swapper.py``: while leaf *i* updates,
+leaf *i+1*'s moments are already being read and leaf *i-1*'s are being written.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.offload.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.offload.swap import AsyncTensorSwapper
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for keypath, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        out.append((name, leaf))
+    return out
+
+
+class HostOffloadOptimizer:
+    def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 gradient_clipping: float = 0.0, schedule_fn=None,
+                 nvme_path: Optional[str] = None, aio_threads: int = 2):
+        self.adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
+                                     weight_decay=weight_decay)
+        self.schedule_fn = schedule_fn
+        self.base_lr = lr
+        self.gradient_clipping = gradient_clipping
+        self.swapper = (AsyncTensorSwapper(os.path.join(nvme_path, "opt_states"),
+                                           num_threads=aio_threads)
+                        if nvme_path else None)
+        # fp32 master copies on host
+        self.master: Dict[str, np.ndarray] = {}
+        self.m: Dict[str, np.ndarray] = {}
+        self.v: Dict[str, np.ndarray] = {}
+        for name, leaf in _leaf_paths(params):
+            host = np.asarray(jax.device_get(leaf), np.float32)
+            self.master[name] = np.ascontiguousarray(host)
+            m = np.zeros_like(host)
+            v = np.zeros_like(host)
+            if self.swapper is not None:
+                self.swapper.swap_out(name + ".m", m)
+                self.swapper.swap_out(name + ".v", v)
+            else:
+                self.m[name], self.v[name] = m, v
+        if self.swapper is not None:
+            self.swapper.wait()
+        total = sum(a.size for a in self.master.values())
+        log_dist(f"host offload optimizer: {total/1e6:.1f}M fp32 master params "
+                 f"({'nvme' if self.swapper else 'cpu'} moments)")
+
+    # ------------------------------------------------------------------
+    def step(self, grads: Any, params: Any, step_num: int):
+        """Update masters from device grads; returns (new device params, skipped).
+
+        ``skipped=True`` (non-finite grad norm, fp16 overflow) leaves every state
+        untouched — the engine keeps its params and shrinks the loss scale."""
+        lr = float(self.schedule_fn(step_num)) if self.schedule_fn else self.base_lr
+        names_leaves = _leaf_paths(grads)
+        host_grads = {n: np.asarray(jax.device_get(g), np.float32)
+                      for n, g in names_leaves}
+
+        gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                                  for g in host_grads.values())))
+        self._last_gnorm = gnorm
+        if not np.isfinite(gnorm):
+            return params, True
+        if self.gradient_clipping > 0 and gnorm > self.gradient_clipping:
+            scale = self.gradient_clipping / (gnorm + 1e-6)
+            for g in host_grads.values():
+                g *= scale
+
+        order = [n for n, _ in names_leaves]
+        self.adam.step_count += 1
+        if self.swapper is not None:
+            # pipelined: prefetch next moments while updating current
+            m_cur = self.swapper.swap_in(order[0] + ".m")
+            v_cur = self.swapper.swap_in(order[0] + ".v")
+            for i, name in enumerate(order):
+                nxt = order[i + 1] if i + 1 < len(order) else None
+                if nxt:
+                    m_nxt = self.swapper.swap_in_start(nxt + ".m")
+                    v_nxt = self.swapper.swap_in_start(nxt + ".v")
+                flat = self.master[name].reshape(-1)
+                self.adam.step(flat, host_grads[name].reshape(-1),
+                               m_cur.reshape(-1), v_cur.reshape(-1), lr=lr,
+                               increment=False)
+                self.swapper.wait()  # finish prefetch (+ prior writeback)
+                self.swapper.swap_out(name + ".m", m_cur)
+                self.swapper.swap_out(name + ".v", v_cur)
+                if nxt:
+                    m_cur, v_cur = m_nxt, v_nxt
+            self.swapper.wait()
+        else:
+            for name in order:
+                self.adam.step(self.master[name].reshape(-1),
+                               host_grads[name].reshape(-1),
+                               self.m[name].reshape(-1), self.v[name].reshape(-1),
+                               lr=lr, increment=False)
+
+        # masters → device, preserving each leaf's sharding + dtype
+        leaves = dict(_leaf_paths(params))
+        new_flat = {}
+        for name, leaf in leaves.items():
+            arr = self.master[name].astype(np.asarray(leaf).dtype, copy=False)
+            new_flat[name] = jax.device_put(arr.reshape(leaf.shape), leaf.sharding)
+        treedef = jax.tree_util.tree_structure(params)
+        ordered = [new_flat[n] for n, _ in _leaf_paths(params)]
+        return jax.tree_util.tree_unflatten(treedef, ordered), False
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        out = {"step": np.int64(self.adam.step_count)}
+        for name in self.master:
+            out["master/" + name] = self.master[name]
+            if self.swapper is not None:
+                out["m/" + name] = self.swapper.swap_in(name + ".m")
+                out["v/" + name] = self.swapper.swap_in(name + ".v")
+            else:
+                out["m/" + name] = self.m[name]
+                out["v/" + name] = self.v[name]
+        return out
+
+    def load_state_dict(self, sd: Dict[str, np.ndarray]) -> None:
+        self.adam.step_count = int(sd["step"])
+        for key, val in sd.items():
+            if key == "step":
+                continue
+            kind, name = key.split("/", 1)
+            if kind == "master":
+                self.master[name] = np.ascontiguousarray(val, np.float32)
+            elif self.swapper is not None:
+                self.swapper.swap_out(name + "." + kind, np.ascontiguousarray(val))
+            else:
+                getattr(self, kind)[name] = np.ascontiguousarray(val, np.float32)
+        if self.swapper is not None:
+            self.swapper.wait()
