@@ -1,0 +1,102 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Metric: training tokens/sec/chip on the flagship decoder LM (single-chip config),
+with MFU derived from the model FLOPs estimate. ``vs_baseline`` is measured MFU over
+the 45% north-star target (BASELINE.md: Llama-3-8B ZeRO-3 ≥45% MFU on v5e-256;
+single-chip proxy here until multi-chip hardware is available).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# bf16 peak TFLOPS per chip by TPU generation
+PEAK_TFLOPS = {
+    "v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "cpu": 1e12,
+}
+
+
+def detect_peak(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, TransformerConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, num_layers=24, num_heads=16,
+            num_kv_heads=8, max_seq_len=2048, arch="llama",
+            remat_policy="dots_saveable")
+        batch, seq, steps, warmup = 4, 2048, 10, 2
+    else:  # dev fallback so the harness is runnable anywhere
+        cfg = TransformerConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                                num_heads=4, max_seq_len=256, arch="llama")
+        batch, seq, steps, warmup = 2, 128, 3, 1
+
+    model = TransformerLM(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, *_ = ds.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {"input_ids": rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)}
+
+    for _ in range(warmup):
+        engine.fused_train_step(make_batch()).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.fused_train_step(make_batch())
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    # FLOPs/token: 6*N for the dense path + attention score/value term
+    n_params = cfg.num_params_estimate()
+    attn_flops_per_token = 12 * cfg.num_layers * seq * cfg.hidden_size
+    flops_per_token = 6 * n_params + attn_flops_per_token
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / detect_peak(dev)
+
+    result = {
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "model_params_m": round(n_params / 1e6, 1),
+            "loss": round(float(loss), 4),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "batch": batch, "seq": seq, "steps": steps,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Autotuning: measured search over ZeRO stage / micro-batch / remat configs.
+
+Parity target: ``deepspeed/autotuning/`` — ``Autotuner`` (autotuner.py:42) profiles
+model info then schedules experiments over ZeRO stages and micro-batch sizes. Here an
+experiment is a jit-compile + a few timed steps in-process (no cluster scheduler
+needed: one trial == one XLA program).
+"""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401

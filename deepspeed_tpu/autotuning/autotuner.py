@@ -1,0 +1,81 @@
+"""In-process autotuner.
+
+Parity target: ``deepspeed/autotuning/autotuner.py:42`` ``Autotuner.tune()`` — the
+reference launches subprocess experiments over (zero stage, micro-batch, offload)
+combos and picks the fastest that fits. On TPU a trial is: build an engine with the
+candidate config, run ``fused_train_step`` a few times, record tokens/sec; OOM →
+candidate rejected (the reference's "model info" prune step is replaced by actually
+asking XLA, which is cheap on one chip).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    ok: bool
+    samples_per_sec: float = 0.0
+    error: str = ""
+
+
+class Autotuner:
+    """Grid search over micro-batch × zero-stage × remat (tuner/ grid parity)."""
+
+    def __init__(self, model_factory: Callable[[], Any], base_config: Dict[str, Any],
+                 micro_batch_candidates: Sequence[int] = (1, 2, 4, 8),
+                 zero_stage_candidates: Sequence[int] = (0, 1, 2, 3),
+                 remat_candidates: Sequence[str] = ("none",),
+                 steps: int = 3, make_batch: Optional[Callable[[int], Any]] = None):
+        self.model_factory = model_factory
+        self.base_config = base_config
+        self.micro_batch_candidates = list(micro_batch_candidates)
+        self.zero_stage_candidates = list(zero_stage_candidates)
+        self.remat_candidates = list(remat_candidates)
+        self.steps = steps
+        self.make_batch = make_batch
+        self.results: List[TrialResult] = []
+
+    def _run_trial(self, mb: int, stage: int) -> TrialResult:
+        import deepspeed_tpu as ds
+
+        cfg = copy.deepcopy(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = mb
+        cfg.pop("train_batch_size", None)
+        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        try:
+            engine, *_ = ds.initialize(model=self.model_factory(), config=cfg)
+            batch = self.make_batch(mb * engine.topology.dp_world_size)
+            engine.fused_train_step(batch)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = engine.fused_train_step(batch)
+            loss.block_until_ready()
+            dt = time.perf_counter() - t0
+            sps = self.steps * engine.train_batch_size() / dt
+            return TrialResult({"micro_batch": mb, "stage": stage}, True, sps)
+        except Exception as e:  # OOM / invalid combo → rejected candidate
+            return TrialResult({"micro_batch": mb, "stage": stage}, False,
+                               error=str(e)[:200])
+
+    def tune(self) -> Optional[TrialResult]:
+        """Return the fastest working (micro_batch, stage) combo."""
+        assert self.make_batch is not None, "make_batch factory is required"
+        for mb, stage in itertools.product(self.micro_batch_candidates,
+                                           self.zero_stage_candidates):
+            r = self._run_trial(mb, stage)
+            self.results.append(r)
+            log_dist(f"autotune trial {r.config}: "
+                     f"{'%.1f samples/s' % r.samples_per_sec if r.ok else 'FAIL ' + r.error}")
+        ok = [r for r in self.results if r.ok]
+        return max(ok, key=lambda r: r.samples_per_sec) if ok else None

@@ -1,0 +1,11 @@
+"""Model compression: weight quantization, pruning, layer reduction.
+
+Parity target: ``deepspeed/compression/`` — ``init_compression`` (compress.py),
+``LinearLayer_Compress`` (basic_layer.py: sparse/row/head pruning + weight/activation
+quantization), ``scheduler.py``. Functional JAX form: transformations over the params
+pytree + straight-through-estimator wrappers for QAT.
+"""
+
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    init_compression, prune_magnitude, quantize_weights_ptq, ste_quantize,
+)

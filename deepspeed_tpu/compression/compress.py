@@ -1,0 +1,83 @@
+"""Compression transforms (compress.py / basic_layer.py parity, functional form)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantization import dequantize_blockwise, quantize_blockwise
+
+
+def quantize_weights_ptq(params: Any, bits: int = 8, group_size: int = 2048,
+                         predicate: Optional[Callable] = None) -> Any:
+    """Post-training weight quantization: fake-quantize matching leaves in place
+    (``LinearLayer_Compress`` weight-quantization mode)."""
+
+    def one(path, leaf):
+        if leaf.ndim < 2 or (predicate is not None and not predicate(path, leaf)):
+            return leaf
+        q, s = quantize_blockwise(leaf, bits=bits, group_size=group_size)
+        return dequantize_blockwise(q, s, bits=bits, shape=leaf.shape,
+                                    dtype=leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+@jax.custom_vjp
+def _ste(x: jax.Array, xq: jax.Array) -> jax.Array:
+    return xq
+
+
+def _ste_fwd(x, xq):
+    return xq, None
+
+
+def _ste_bwd(_, g):
+    return g, None  # straight-through: gradient flows to the fp weight
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def ste_quantize(x: jax.Array, bits: int = 8, group_size: int = 2048) -> jax.Array:
+    """Quantization-aware-training fake quant with straight-through gradients
+    (``QuantAct``/weight QAT parity)."""
+    q, s = quantize_blockwise(x, bits=bits, group_size=group_size)
+    xq = dequantize_blockwise(q, s, bits=bits, shape=x.shape, dtype=x.dtype)
+    return _ste(x, xq)
+
+
+def prune_magnitude(params: Any, sparsity: float,
+                    predicate: Optional[Callable] = None) -> Any:
+    """Unstructured magnitude pruning (sparse_pruning parity)."""
+
+    def one(path, leaf):
+        if leaf.ndim < 2 or (predicate is not None and not predicate(path, leaf)):
+            return leaf
+        flat = jnp.abs(leaf).reshape(-1)
+        k = int(flat.size * sparsity)
+        if k <= 0:
+            return leaf
+        thresh = jnp.sort(flat)[k - 1]
+        return jnp.where(jnp.abs(leaf) > thresh, leaf, 0).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def init_compression(engine_or_params, compression_config: Optional[Dict] = None):
+    """``init_compression`` parity: apply configured transforms to a params tree
+    (or an engine's params in place)."""
+    cc = compression_config or {}
+    params = getattr(engine_or_params, "params", engine_or_params)
+    wq = cc.get("weight_quantization", {})
+    if wq.get("enabled"):
+        params = quantize_weights_ptq(params, bits=int(wq.get("bits", 8)))
+    sp = cc.get("sparse_pruning", {})
+    if sp.get("enabled"):
+        params = prune_magnitude(params, float(sp.get("sparsity", 0.5)))
+    if hasattr(engine_or_params, "params"):
+        engine_or_params.params = params
+        return engine_or_params
+    return params

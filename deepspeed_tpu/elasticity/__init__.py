@@ -1,0 +1,11 @@
+"""Elastic training: batch-compatible world sizes + resume math.
+
+Parity target: ``deepspeed/elasticity/elasticity.py`` — ``compute_elastic_config``
+(:233) and the v0.1/v0.2 candidate-batch algorithms (:83/:126). The agent/rendezvous
+half (``DSElasticAgent``) maps to the pod scheduler restarting hosts + checkpoint
+resume; the portable part is exactly this math.
+"""
+
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    compute_elastic_config, get_compatible_chip_counts,
+)

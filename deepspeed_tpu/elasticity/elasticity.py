@@ -1,0 +1,69 @@
+"""Elastic batch math (elasticity.py:83-:300 parity, TPU slice-aware)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def _candidate_batches(max_acceptable_batch_size: int, micro_batches: List[int]
+                       ) -> List[int]:
+    """All global batch sizes expressible as micro_batch * k ≤ max
+    (``_get_candidate_batch_sizes`` elasticity.py:83)."""
+    candidates = set()
+    for mb in micro_batches:
+        batch = mb
+        while batch <= max_acceptable_batch_size:
+            candidates.add(batch)
+            batch += mb
+    return sorted(candidates, reverse=True)
+
+
+def get_compatible_chip_counts(batch_size: int, micro_batches: List[int],
+                               min_chips: int, max_chips: int,
+                               chips_per_host: int = 1) -> List[int]:
+    """Chip counts that divide the batch with some micro-batch size
+    (``_get_compatible_gpus`` elasticity.py:96)."""
+    out = []
+    for n in range(min_chips, max_chips + 1):
+        if chips_per_host > 1 and n % chips_per_host != 0:
+            continue
+        if any(batch_size % (n * mb) == 0 for mb in micro_batches):
+            out.append(n)
+    return out
+
+
+def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = None
+                           ) -> Tuple[int, List[int], Dict[int, int]]:
+    """Pick the global batch size maximizing chip-count compatibility.
+
+    Args (keys of ``elastic_config``, reference config schema):
+        max_train_batch_size, micro_batch_sizes, min_gpus, max_gpus, prefer_larger_batch
+    Returns:
+        (global_batch, compatible_chip_counts, {chips: micro_batch}) — constant
+        global batch across every admissible world size (the elastic guarantee).
+    """
+    max_batch = int(elastic_config["max_train_batch_size"])
+    micro_batches = sorted(int(m) for m in elastic_config["micro_batch_sizes"])
+    min_chips = int(elastic_config.get("min_gpus", 1))
+    max_chips = int(elastic_config.get("max_gpus", 1024))
+    prefer_larger = bool(elastic_config.get("prefer_larger_batch", True))
+
+    best: Tuple[int, List[int]] = (0, [])
+    for batch in _candidate_batches(max_batch, micro_batches):
+        chips = get_compatible_chip_counts(batch, micro_batches, min_chips, max_chips)
+        if len(chips) > len(best[1]) or (
+                len(chips) == len(best[1]) and prefer_larger and batch > best[0]):
+            best = (batch, chips)
+    batch, chips = best
+    if not chips:
+        raise ValueError(f"no chip count in [{min_chips}, {max_chips}] is compatible "
+                         f"with batch ≤ {max_batch} and micro batches {micro_batches}")
+
+    micro_per_chips: Dict[int, int] = {}
+    for n in chips:
+        # largest micro batch that divides the per-chip share (throughput-optimal)
+        micro_per_chips[n] = max(mb for mb in micro_batches if batch % (n * mb) == 0)
+    if target_chips is not None and target_chips not in micro_per_chips:
+        raise ValueError(f"current world size {target_chips} is not elastic-compatible "
+                         f"(valid: {chips})")
+    return batch, chips, micro_per_chips

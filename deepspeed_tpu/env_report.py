@@ -1,0 +1,55 @@
+"""Environment/compatibility report.
+
+Parity target: ``deepspeed/env_report.py`` + ``bin/ds_report`` — report platform,
+device inventory, op availability and versions. Run: ``python -m
+deepspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def report() -> str:
+    lines = ["-" * 60, "deepspeed_tpu environment report", "-" * 60]
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.ops import op_report
+
+    lines.append(f"deepspeed_tpu version: {deepspeed_tpu.__version__}")
+    lines.append(f"python: {sys.version.split()[0]}")
+    lines.append(f"jax: {jax.__version__}")
+    try:
+        import jaxlib
+
+        lines.append(f"jaxlib: {jaxlib.__version__}")
+    except Exception:
+        pass
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            lines.append(f"{mod}: {getattr(m, '__version__', '?')}")
+        except Exception:
+            lines.append(f"{mod}: NOT FOUND")
+    try:
+        devs = jax.devices()
+        lines.append(f"backend: {jax.default_backend()}  devices: {len(devs)}")
+        for d in devs[:8]:
+            lines.append(f"  [{d.id}] {getattr(d, 'device_kind', d.platform)}")
+    except Exception as e:
+        lines.append(f"device init failed: {e}")
+    lines.append("-" * 60)
+    lines.append("op compatibility:")
+    for name, ok in op_report():
+        lines.append(f"  {name:<20} {'[OK]' if ok else '[UNAVAILABLE]'}")
+    lines.append("-" * 60)
+    return "\n".join(lines)
+
+
+def main() -> None:
+    print(report())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Launcher: multi-host process fan-out and rendezvous env plumbing.
+
+Parity target: ``deepspeed/launcher/`` (runner.py hostfile parse + launcher select,
+launch.py per-rank spawn, multinode_runner.py PDSH/SLURM/MPI backends).
+"""
+
+from deepspeed_tpu.launcher.runner import main, parse_hostfile  # noqa: F401

@@ -1,0 +1,9 @@
+"""Profiling: FLOPs/memory analysis + trace capture.
+
+Parity target: ``deepspeed/profiling/flops_profiler/profiler.py:30`` — the torch
+version monkey-patches ``torch.nn.functional`` to count MACs. On TPU the compiler
+already knows: XLA's HLO cost analysis gives exact flops/bytes for the *optimized*
+program, and ``jax.profiler`` produces xprof traces (the NVTX/nsys analog).
+"""
+
+from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, profile_fn  # noqa: F401
