@@ -56,7 +56,12 @@ struct Handle {
         queue.pop_front();
       }
       if (run_op(op) != 0) errors.fetch_add(1);
-      if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+      if (inflight.fetch_sub(1) == 1) {
+        // lock (then release) mu before notifying so the wake can't fall in the
+        // gap between ds_aio_wait's predicate check and its sleep
+        { std::lock_guard<std::mutex> lk(mu); }
+        cv_done.notify_all();
+      }
     }
   }
 

@@ -352,7 +352,7 @@ class TransformerLM:
                 "attn": {
                     "wq": layer_stack(keys[1], D, (D, H * hd)),
                     "wk": layer_stack(keys[2], D, (D, K * hd)),
-                    "wv": layer_stack(keys[2], D, (D, K * hd)),
+                    "wv": layer_stack(keys[10], D, (D, K * hd)),
                     "wo": layer_stack(keys[3], H * hd, (H * hd, D)),
                 },
                 "mlp": mlp,

@@ -40,9 +40,37 @@ def _tag_dir(save_dir: str, tag: str) -> str:
     return os.path.abspath(os.path.join(save_dir, tag))
 
 
+def finalize_pending(engine) -> None:
+    """Block until an in-flight async save commits (and its ``latest`` is written).
+
+    The commit protocol (reference ``checkpoint_engine.py:21`` create/save/commit):
+    ``latest`` only ever points at a fully-committed checkpoint, so a crash
+    mid-async-save leaves the previous checkpoint resumable.
+    """
+    pending = getattr(engine, "_pending_ckpt", None)
+    if pending is None:
+        return
+    engine._pending_ckpt = None
+    ckptr, commit_thread, error_box = pending
+    commit_thread.join()
+    # surface any IO error that the background commit swallowed
+    ckptr.wait_until_finished()
+    if error_box:
+        raise error_box[0]
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None) -> str:
-    """Write a tagged sharded checkpoint + ``latest`` pointer."""
+    """Write a tagged sharded checkpoint + ``latest`` pointer.
+
+    ``latest`` is written only after the data is durably committed — immediately
+    for sync saves, from a commit thread after ``wait_until_finished`` for async
+    saves — and any prior in-flight async save is finalized first so IO errors
+    are never silently dropped.
+    """
+    import threading
+
+    finalize_pending(engine)
     tag = tag or f"global_step{engine.global_steps}"
     path = _tag_dir(save_dir, tag)
     os.makedirs(path, exist_ok=True)
@@ -58,10 +86,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # host optimizer tier (ZeRO-Offload/Infinity) lives outside the orbax tree
         np.savez(os.path.join(path, "host_optimizer.npz"),
                  **engine._offload.state_dict())
-    if async_save:
-        engine._pending_ckpt = ckptr  # commit protocol: wait on next save/exit
-    elif hasattr(ckptr, "wait_until_finished"):
-        ckptr.wait_until_finished()
     meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -76,8 +100,39 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
-            f.write(tag)
+
+    def _write_latest():
+        if jax.process_index() == 0:
+            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
+                f.write(tag)
+
+    if async_save:
+        import atexit
+        import weakref
+
+        error_box: list = []
+
+        def _commit():
+            try:
+                ckptr.wait_until_finished()
+                _write_latest()
+                log_dist(f"committed async checkpoint {path}")
+            except Exception as e:  # re-raised to the caller by finalize_pending
+                error_box.append(e)
+                logger.exception(f"async checkpoint commit failed for {path}")
+
+        # non-daemon: interpreter exit joins the thread, so the final save of a
+        # run always gets its 'latest' pointer; atexit additionally surfaces
+        # commit errors if the user never saves/loads again
+        t = threading.Thread(target=_commit, daemon=False, name="ckpt-commit")
+        t.start()
+        engine._pending_ckpt = (ckptr, t, error_box)
+        ref = weakref.ref(engine)
+        atexit.register(lambda: finalize_pending(ref()) if ref() else None)
+    else:
+        if hasattr(ckptr, "wait_until_finished"):
+            ckptr.wait_until_finished()
+        _write_latest()
     log_dist(f"saved checkpoint {path} (async={async_save})")
     return path
 
@@ -95,6 +150,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     """Restore into the engine's *current* shardings (any topology → any topology)."""
     import orbax.checkpoint as ocp
 
+    finalize_pending(engine)
     tag = tag or read_latest_tag(load_dir)
     if tag is None:
         logger.warning(f"no 'latest' file in {load_dir}; nothing restored")

@@ -53,7 +53,8 @@ def test_scan_matches_unrolled(tiny_batch):
     params = m_scan.init(jax.random.key(0))
     l1 = m_scan.loss_fn(params, tiny_batch)
     l2 = m_loop.loss_fn(params, tiny_batch)
-    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    # scan vs unrolled layers fuse in different orders; small fp drift is expected
+    np.testing.assert_allclose(float(l1), float(l2), rtol=5e-5)
 
 
 def test_gqa_heads():
