@@ -38,10 +38,13 @@ def main() -> None:
     on_tpu = dev.platform != "cpu"
 
     if on_tpu:
+        # flagship single-chip config tuned for v5e HBM/MXU: d=128 heads (MXU
+        # lane-width), dots_and_attn_saveable remat (never recompute the
+        # VPU-bound attention kernel), params cast once per step
         cfg = TransformerConfig(
-            vocab_size=32000, hidden_size=1024, num_layers=24, num_heads=16,
-            num_kv_heads=8, max_seq_len=2048, arch="llama",
-            remat_policy="dots_saveable")
+            vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
+            num_kv_heads=6, max_seq_len=2048, arch="llama",
+            remat_policy="dots_and_attn_saveable")
         batch, seq, steps, warmup = 4, 2048, 10, 2
     else:  # dev fallback so the harness is runnable anywhere
         cfg = TransformerConfig(vocab_size=1024, hidden_size=128, num_layers=2,

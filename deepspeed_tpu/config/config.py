@@ -180,7 +180,7 @@ class ActivationCheckpointingConfig(DSTpuConfigModel):
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
     # jax-native: which remat policy to apply to each scanned block
-    policy: str = "none"  # none|full|dots_saveable|nothing_saveable|offload_dots
+    policy: str = "none"  # see runtime.activation_checkpointing.POLICIES
 
 
 class CommsLoggerConfig(DSTpuConfigModel):

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.sharding import constrain
@@ -53,7 +54,7 @@ class TransformerConfig:
 
     dtype: str = "bfloat16"        # compute dtype
     param_dtype: str = "float32"   # storage dtype (master weights)
-    remat_policy: str = "none"     # none|full|dots_saveable|nothing_saveable
+    remat_policy: str = "none"     # runtime.activation_checkpointing.POLICIES
     scan_layers: bool = True
     attention_impl: str = "auto"   # auto|xla|flash|ring
     z_loss: float = 0.0
@@ -140,7 +141,10 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = Tr
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v)
+    # same remat tag as the pallas kernel so attn_saveable policies also pin
+    # the XLA fallback's output instead of silently recomputing it
+    return checkpoint_name(out, "flash_attn_out")
 
 
 # ---------------------------------------------------------------------------
@@ -263,23 +267,14 @@ def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     return constrain(x, P(("dp", "fsdp"), "sp", None)), aux
 
 
-_REMAT_POLICIES = {
-    "none": None,
-    "full": "full",
-    "dots_saveable": "dots_saveable",
-    "nothing_saveable": "nothing_saveable",
-    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
-}
-
-
 def _maybe_remat(fn: Callable, policy: str) -> Callable:
     """Map the activation-checkpointing config to ``jax.checkpoint``
-    (reference: ``runtime/activation_checkpointing/checkpointing.py:948``)."""
-    if policy in (None, "none"):
-        return fn
-    if policy == "full":
-        return jax.checkpoint(fn)
-    return jax.checkpoint(fn, policy=getattr(jax.checkpoint_policies, policy))
+    (reference: ``runtime/activation_checkpointing/checkpointing.py:948``);
+    policy names resolve through the shared
+    ``runtime.activation_checkpointing.resolve_policy``."""
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpoint_wrapper
+
+    return checkpoint_wrapper(fn, policy=policy)
 
 
 def lm_loss(cfg: TransformerConfig, logits: jax.Array,
@@ -382,6 +377,14 @@ class TransformerLM:
         attn_fn = get_attention_impl(cfg.attention_impl)
         freqs = self._freqs
 
+        # Cast the whole layer stack to compute dtype ONCE, outside the layer
+        # scan: the per-layer cast inside transformer_block then no-ops. Done
+        # per layer (and re-done under remat) this was a full extra pass over
+        # the fp32 master weights every micro-batch.
+        layers = jax.tree_util.tree_map(
+            lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+            params["layers"])
+
         def body(carry, layer_w):
             y, aux = transformer_block(carry, layer_w, cfg, freqs, attn_fn,
                                        self.moe_fn)
@@ -389,12 +392,12 @@ class TransformerLM:
 
         body = _maybe_remat(body, cfg.remat_policy)
         if cfg.scan_layers:
-            x, auxes = jax.lax.scan(body, x, params["layers"])
+            x, auxes = jax.lax.scan(body, x, layers)
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                layer_w = jax.tree_util.tree_map(lambda p: p[i], params["layers"])
+                layer_w = jax.tree_util.tree_map(lambda p: p[i], layers)
                 x, aux = body(x, layer_w)
                 aux_total = aux_total + aux
         x = _norm(x, {k: v for k, v in params["final_norm"].items()}, cfg.norm,

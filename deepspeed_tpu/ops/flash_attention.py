@@ -28,11 +28,12 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
@@ -65,24 +66,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        # MXU wants low-precision inputs with fp32 accumulation: keep q/k/v in
+        # their storage dtype (bf16) and set preferred_element_type — an fp32
+        # cast before the dot would run the MXU at a fraction of its bf16 rate.
+        q = q_ref[0, 0]                      # [bq, d]
+        k = k_ref[0, 0]                      # [bk, d]
+        v = v_ref[0, 0]                      # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            # rows+q_start >= cols+k_start  ⟺  rows-cols >= k_start-q_start:
+            # the iota difference is block-invariant, only the scalar threshold
+            # moves, which keeps the per-block VPU mask work to compare+select
+            diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                    - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(diff >= k_start - q_start, s, NEG_INF)
         m_prev = m_scr[:, :1]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                # [bq, bk]
+        p = jnp.exp(s - m_new)                # [bq, bk] fp32
         corr = jnp.exp(m_prev - m_new)        # [bq, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(ik == nk - 1)
@@ -144,10 +152,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                   # [bq, 1]
         delta = delta_ref[0, 0]               # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -159,7 +167,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         p = jnp.exp(s - lse)                  # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_scr[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -184,10 +192,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -197,11 +205,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                   # [bq, bk]
+        pc = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_scr[:] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -326,4 +335,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = 
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     out = _flash(qt, kt, vt, causal, bq, bk, interpret)
-    return out.transpose(0, 2, 1, 3)
+    out = out.transpose(0, 2, 1, 3)
+    # Named so remat policies can pin the kernel's output: attention is
+    # VPU-bound (~5-10% MFU ceiling at trainable seq lens on v5e) and must
+    # never be recomputed in the backward pass.
+    return checkpoint_name(out, "flash_attn_out")

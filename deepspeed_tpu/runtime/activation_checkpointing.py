@@ -17,14 +17,44 @@ from typing import Any, Callable, Optional
 
 import jax
 
-_POLICY_MAP = {
-    "none": None,
-    "full": "full",
-    "dots_saveable": "dots_saveable",
-    "nothing_saveable": "nothing_saveable",
-    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
-    "offload_dots": "save_and_offload_only_these_names",
-}
+#: canonical policy names → how :func:`checkpoint_wrapper` resolves them
+POLICIES = (
+    "none", "full", "dots_saveable", "nothing_saveable",
+    "dots_with_no_batch_dims_saveable", "attn_saveable",
+    "dots_and_attn_saveable", "offload_dots",
+)
+
+#: the checkpoint_name tag attached by ops/flash_attention.py (and the XLA
+#: fallback) to the attention output so policies can pin it
+ATTN_CHECKPOINT_NAME = "flash_attn_out"
+
+
+def resolve_policy(policy: str):
+    """Map a policy name to a ``jax.checkpoint_policies`` callable (or None).
+
+    This is the single mapping used by both the model-side remat
+    (``models/transformer.py``) and the engine-side :func:`checkpoint_wrapper`.
+    """
+    if policy in (None, "none", "full"):
+        return None
+    cp = jax.checkpoint_policies
+    if policy == "attn_saveable":
+        # save only the attention output: cheapest memory profile that still
+        # avoids recomputing the VPU-bound attention in the backward pass
+        return cp.save_only_these_names(ATTN_CHECKPOINT_NAME)
+    if policy == "dots_and_attn_saveable":
+        # dots_saveable alone recomputes the (opaque-to-XLA) pallas attention
+        # call in the backward; pin its named output as well
+        return cp.save_from_both_policies(
+            cp.dots_saveable, cp.save_only_these_names(ATTN_CHECKPOINT_NAME))
+    if policy == "offload_dots":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            offload_src="device", offload_dst="pinned_host")
+    if policy not in POLICIES:
+        raise ValueError(f"unknown remat policy '{policy}' "
+                         f"(have {sorted(POLICIES)})")
+    return getattr(cp, policy)
 
 
 def configure(config) -> dict:
@@ -42,12 +72,4 @@ def checkpoint_wrapper(function: Callable, policy: str = "full") -> Callable:
         return function
     if policy == "full":
         return jax.checkpoint(function)
-    if policy == "offload_dots":
-        pol = jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
-            offload_src="device", offload_dst="pinned_host")
-        return jax.checkpoint(function, policy=pol)
-    if policy not in _POLICY_MAP:
-        raise ValueError(f"unknown remat policy '{policy}' "
-                         f"(have {sorted(_POLICY_MAP)})")
-    return jax.checkpoint(function, policy=getattr(jax.checkpoint_policies, policy))
+    return jax.checkpoint(function, policy=resolve_policy(policy))
