@@ -170,9 +170,14 @@ def all_to_all(x: jax.Array, axis: AxisName, split_dim: int, concat_dim: int,
 
 
 def broadcast(x: jax.Array, src: int, axis: AxisName) -> jax.Array:
-    """Everyone gets rank ``src``'s value along ``axis``."""
+    """Everyone gets rank ``src``'s value along ``axis``.
+
+    Implemented as mask-then-psum: O(payload) per link (an all_gather-then-index
+    would move world_size × payload)."""
     _log("broadcast", x)
-    return lax.all_gather(x, axis, axis=0, tiled=False)[src]
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
 
 
 def ppermute(x: jax.Array, axis: AxisName, perm: Sequence[tuple]) -> jax.Array:

@@ -4,9 +4,11 @@ Parity target: ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``) —
 engine builds a loader from ``training_data`` with the resolved micro-batch size and a
 per-dp-rank distributed sampler. On TPU the whole global batch is assembled on host and
 sharded over the (dp, fsdp) mesh axes by the engine's jit in_shardings, so the loader
-yields **global** batches of ``micro_batch * dp_world_size`` examples; under multi-host
-each process loads only its slice (process-index stride, the distributed-sampler
-equivalent).
+yields **global** batches of ``micro_batch * dp_world_size`` examples. Under
+multi-host every process materializes the full global batch on host (same RNG seed
+→ same order) and ``jax.device_put`` extracts each host's local shards; a
+process-index-strided loader is a possible future optimization for host-RAM-bound
+datasets.
 """
 
 from __future__ import annotations

@@ -188,11 +188,19 @@ class DeepSpeedTpuEngine:
         self._accum = jax.jit(accum, donate_argnums=(0,),
                               out_shardings=self.grad_sharding)
 
-        ga = float(self.config.gradient_accumulation_steps)
+        ga_build = float(self.config.gradient_accumulation_steps)
 
-        def apply_step(params, opt_state, grads, scaler):
+        def apply_step(params, opt_state, grads, scaler, *, ga=ga_build):
+            """Unscale → clip/step → (fp16) overflow-skip + scaler update.
+
+            Shared verbatim between the imperative ``step()`` jit and the fused
+            single-jit train step so the perf path and the parity path keep
+            identical semantics (loss scaling, skip, scaler window). ``ga`` is
+            keyword-only so fused callers pass their own accumulation factor
+            rather than silently inheriting the build-time value."""
             scale = scaler["scale"]
-            grads = jax.tree_util.tree_map(lambda g: g / (scale * ga), grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / (scale * ga), grads)
             gnorm = optax.global_norm(grads)
             if fp16:
                 finite = jnp.isfinite(gnorm)
@@ -210,6 +218,7 @@ class DeepSpeedTpuEngine:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, scaler, gnorm, jnp.zeros((), bool)
 
+        self._apply_body = apply_step
         self._apply = jax.jit(
             apply_step, donate_argnums=(0, 1, 2),
             out_shardings=(self.param_sharding, self.opt_sharding, None, None, None))
@@ -342,14 +351,19 @@ class DeepSpeedTpuEngine:
         self._grad_acc = None
         self._grad_acc_count = 0
         self._last_gnorm = gnorm
-        if bool(skipped):
+        self._commit_step(bool(skipped))
+        self.tput_timer.stop(global_step=True, report_speed=True)
+
+    def _commit_step(self, skipped: bool) -> None:
+        """Shared end-of-step bookkeeping for the imperative, fused, and fused
+        offload paths: skip accounting, LR schedule, progress + monitor."""
+        if skipped:
             self.skipped_steps += 1
         else:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
         self.global_samples += int(self.config.train_batch_size)
-        self.tput_timer.stop(global_step=True, report_speed=True)
         if self.global_steps and self.global_steps % self.config.steps_per_print == 0:
             self._report_progress()
         if self.monitor is not None:
@@ -375,47 +389,89 @@ class DeepSpeedTpuEngine:
         return total / int(self.config.gradient_accumulation_steps)
 
     # ---- fused single-jit step (bench / graft path) -------------------
+    def _fused_grads(self, params, batch, scale, ga: int):
+        """GA scan producing (summed scaled-loss grads, mean loss) — the shared
+        forward/backward half of the fused step."""
+        model = self.module
+
+        def micro(acc, mb):
+            loss, grads = jax.value_and_grad(
+                lambda p, b: model.loss_fn(p, b) * scale)(params, mb)
+            return jax.tree_util.tree_map(jnp.add, acc, grads), loss / scale
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        if ga > 1:
+            mbs = jax.tree_util.tree_map(
+                lambda x: x.reshape((ga, x.shape[0] // ga) + x.shape[1:]), batch)
+            grads, losses = jax.lax.scan(micro, zeros, mbs)
+            return grads, losses.mean()
+        grads, loss = micro(zeros, batch)
+        return grads, loss
+
     def fused_train_step(self, batch):
         """GA loop + apply inside ONE jit: batch leading dim = ga*micro*dp examples.
 
-        This is the performance path — everything (grad accumulation scan, collectives,
-        optimizer) compiles into a single XLA program with full overlap.
+        This is the performance path — everything (grad accumulation scan,
+        collectives, optimizer) compiles into a single XLA program with full
+        overlap — with the SAME semantics as forward/backward/step: fp16 loss
+        scaling, overflow skip and scaler update ride inside the jit, and the
+        host-offload optimizer is supported via a fused grads-only program.
         """
         ga = int(self.config.gradient_accumulation_steps)
+        if self._offload is not None:
+            return self._fused_offload_step(batch, ga)
         key = ga
         if key not in self._fused_step_cache:
-            model, tx = self.module, self.tx
-
             def fused(params, opt_state, batch, scaler):
-                def micro(acc, mb):
-                    loss, grads = jax.value_and_grad(model.loss_fn)(params, mb)
-                    return jax.tree_util.tree_map(jnp.add, acc, grads), loss
-
-                zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-                if ga > 1:
-                    mbs = jax.tree_util.tree_map(
-                        lambda x: x.reshape((ga, x.shape[0] // ga) + x.shape[1:]), batch)
-                    grads, losses = jax.lax.scan(micro, zeros, mbs)
-                    loss = losses.mean()
-                else:
-                    grads, loss = micro(zeros, batch)
-                grads = jax.tree_util.tree_map(lambda g: g / ga, grads)
-                gnorm = optax.global_norm(grads)
-                updates, new_opt = tx.update(grads, opt_state, params)
-                new_params = optax.apply_updates(params, updates)
-                return new_params, new_opt, loss, gnorm
+                grads, loss = self._fused_grads(params, batch, scaler["scale"], ga)
+                new_params, new_opt, new_scaler, gnorm, skipped = \
+                    self._apply_body(params, opt_state, grads, scaler, ga=float(ga))
+                return new_params, new_opt, new_scaler, loss, gnorm, skipped
 
             self._fused_step_cache[key] = jax.jit(
                 fused, donate_argnums=(0, 1),
-                out_shardings=(self.param_sharding, self.opt_sharding, None, None))
+                out_shardings=(self.param_sharding, self.opt_sharding,
+                               None, None, None, None))
         batch = self._put_batch(batch)
         with jax.sharding.set_mesh(self.mesh):
-            self.params, self.opt_state, loss, gnorm = self._fused_step_cache[key](
+            (self.params, self.opt_state, self.scaler_state, loss, gnorm,
+             skipped) = self._fused_step_cache[key](
                 self.params, self.opt_state, batch, self.scaler_state)
         self._last_loss, self._last_gnorm = loss, gnorm
-        self.global_steps += 1
-        self.global_samples += int(self.config.train_batch_size)
+        # only fp16 can skip; reading `skipped` otherwise would force a host
+        # sync per step and serialize the dispatch pipeline
+        self._commit_step(self.fp16_enabled and bool(skipped))
+        return loss
+
+    def _fused_offload_step(self, batch, ga: int):
+        """Fused fwd/bwd jit + host optimizer step (ZeRO-Offload/Infinity)."""
+        key = ("offload", ga)
+        if key not in self._fused_step_cache:
+            def grads_fn(params, batch, scaler):
+                scale = scaler["scale"]
+                grads, loss = self._fused_grads(params, batch, scale, ga)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / (scale * ga), grads)
+                return grads, loss
+
+            self._fused_step_cache[key] = jax.jit(
+                grads_fn, out_shardings=(self.grad_sharding, None))
+        batch = self._put_batch(batch)
+        with jax.sharding.set_mesh(self.mesh):
+            grads, loss = self._fused_step_cache[key](
+                self.params, batch, self.scaler_state)
+        new_params, skipped = self._offload.step(grads, self.params,
+                                                 self.global_steps)
+        if not skipped:
+            self.params = new_params
+        if self.fp16_enabled:
+            self.scaler_state = jax.tree_util.tree_map(
+                jnp.asarray,
+                self._scaler_update(self.scaler_state, jnp.asarray(not skipped)))
+        self._last_loss = loss
+        self._last_gnorm = jnp.float32(self._offload._last_gnorm)
+        self._commit_step(bool(skipped))
         return loss
 
     # ------------------------------------------------------------------

@@ -106,8 +106,12 @@ class PipelineModule:
 
         ids = batch["input_ids"]
         B, T = ids.shape
-        while B % M != 0:
-            M -= 1
+        if B % M != 0:
+            raise ValueError(
+                f"pipeline micro_batches={M} must divide the global batch {B} "
+                "(reference PipelineEngine requires train_batch_size = "
+                "micro_batch * gas * dp; adjust pipeline.micro_batches or the "
+                "batch size)")
         mb = B // M
 
         # embedding (computed on every stage; only stage 0's result is consumed)

@@ -147,3 +147,46 @@ def test_checkpoint_reshard(tmp_path, eight_devices):
     eng2.load_checkpoint(str(tmp_path))
     l2 = train_steps(eng2, 1, seed=9)
     assert np.isfinite(l2[0])
+
+
+def test_fused_matches_imperative_fp16(eight_devices):
+    """fused_train_step must carry the fp16 loss-scaler semantics of the
+    forward/backward/step path (reference weak spot: the fused path silently
+    dropping DynamicLossScaler)."""
+    # scale 2^126 (still finite in fp32): loss*scale overflows to inf, so step 1
+    # must be SKIPPED and the scale halved — on both paths identically
+    cfg = make_config(0, {"dp": 8}, fp16={"enabled": True, "initial_scale_power": 126})
+    m1 = TransformerLM(get_preset("tiny"))
+    e1, *_ = ds.initialize(model=m1, config=cfg)
+    m2 = TransformerLM(get_preset("tiny"))
+    e2, *_ = ds.initialize(model=m2, config=cfg)
+    it = data_iter(16)
+    batch = next(it)
+    l_imp = None
+    for _ in range(3):
+        loss = e1.forward(batch)
+        e1.backward(loss)
+        e1.step()
+        l_imp = float(loss)
+    for _ in range(3):
+        l_fused = float(e2.fused_train_step(batch))
+    assert e1.skipped_steps >= 1, "overflow case never triggered"
+    assert e1.skipped_steps == e2.skipped_steps
+    assert e1.global_steps == e2.global_steps
+    assert float(e1.scaler_state["scale"]) == float(e2.scaler_state["scale"])
+    assert float(e1.scaler_state["scale"]) < 2.0 ** 126  # halved after overflow
+    np.testing.assert_allclose(l_imp, l_fused, rtol=2e-2)
+
+
+def test_fused_step_with_offload(tmp_path, eight_devices):
+    """fused_train_step must work with the host-offload optimizer."""
+    cfg = make_config(
+        2, {"dp": 8},
+        zero_optimization={"stage": 2,
+                           "offload_optimizer": {"device": "cpu"}})
+    model = TransformerLM(get_preset("tiny"))
+    eng, *_ = ds.initialize(model=model, config=cfg)
+    it = data_iter(16)
+    losses = [float(eng.fused_train_step(next(it))) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert eng.global_steps == 4
