@@ -2,16 +2,22 @@
 
 Parity target: ``deepspeed/inference/v2/engine_v2.py`` ``InferenceEngineV2`` — ``put``
 (:107: one step over a ragged batch of prompt chunks + decode tokens), ``query``/
-``flush`` scheduling surface, backed by the blocked KV allocator. Device-side
-execution uses the model's per-slot-position dense step
-(``TransformerLM.forward_with_cache``): each scheduled sequence occupies a tile row
-with its own cache position, so a single jitted step advances a mixed
-prefill+decode batch — the ragged-batch semantics on MXU-friendly dense tiles.
+``flush`` scheduling surface, backed by the blocked KV allocator.
+
+Device-side execution is **paged**: the KV cache is a global pool of fixed-size
+blocks (``[L, num_blocks+1, block_size, K, d]``) and each sequence owns only the
+blocks its length requires — HBM footprint follows allocated blocks, not
+``max_sequences × max_seq_len`` (the waste FastGen's paged KV exists to remove,
+``v2/ragged/kv_cache.py``). The ``BlockedAllocator``'s block ids ARE the
+physical pool indices; host-side scheduling builds the block tables the Pallas
+paged-attention kernel (``ops/paged_attention.py``) consumes via scalar
+prefetch. A ``paged=False`` escape hatch keeps the dense per-slot cache
+(``TransformerLM.forward_with_cache``) for A/B testing.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -24,16 +30,58 @@ from deepspeed_tpu.utils.logging import log_dist
 
 class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params=None, max_sequences: int = 8,
-                 max_seq_len: Optional[int] = None, block_size: int = 128):
+                 max_seq_len: Optional[int] = None, block_size: int = 128,
+                 num_blocks: Optional[int] = None, paged: bool = True,
+                 topology=None, mesh: Optional[dict] = None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.parallel import build_mesh
+        from deepspeed_tpu.parallel import sharding as shd
+
         self.module = model
         self.cfg = model.cfg
         self.max_seq_len = max_seq_len or self.cfg.max_seq_len
-        self.state = SequenceManager(max_sequences, self.max_seq_len, block_size)
-        if params is None:
-            params = model.init(jax.random.key(0))
+        self.paged = paged
+        if topology is None:
+            from deepspeed_tpu.config.config import MeshConfig
+
+            topology = build_mesh(MeshConfig(**(mesh or {})))
+        self.topology = topology
+        self.mesh = self.topology.mesh
+        self.state = SequenceManager(max_sequences, self.max_seq_len, block_size,
+                                     num_blocks=num_blocks)
+        # TP-sharded params (reference InferenceEngineV2 TP via sharded model
+        # implementations, v2/model_implementations/sharding/)
+        specs = model.param_specs() if hasattr(model, "param_specs") else None
+        spec_tree = shd.zero_param_specs(
+            jax.eval_shape(model.init, jax.random.key(0)), specs, self.topology,
+            stage=0)
+        self.param_sharding = shd.named(self.topology, spec_tree)
+        with jax.sharding.set_mesh(self.mesh):
+            if params is None:
+                params = jax.jit(model.init,
+                                 out_shardings=self.param_sharding)(jax.random.key(0))
+            else:
+                params = jax.device_put(params, self.param_sharding)
         self.params = params
-        self.cache = model.init_kv_cache(max_sequences, self.max_seq_len)
-        self._step = jax.jit(model.forward_with_cache)
+        self.block_size = block_size
+        self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
+        if paged:
+            self.num_blocks = self.state.allocator.num_blocks
+            cache = model.init_paged_kv_cache(self.num_blocks, block_size)
+            # pool sharded over tp on the kv-head dim ([L, nb+1, bs, K, d])
+            kv_spec = shd.filter_spec(P(None, None, None, "tp", None),
+                                      self.mesh.axis_names)
+            self.cache = jax.device_put(
+                cache, NamedSharding(self.mesh, kv_spec))
+            self._pos = np.zeros((max_sequences,), np.int32)
+            self._step = jax.jit(model.forward_with_paged_cache)
+            log_dist(f"paged KV pool: {self.num_blocks} blocks x {block_size} "
+                     f"tokens ({self.cache['k'].nbytes * 2 / 1e6:.0f} MB), "
+                     f"mesh={self.topology}")
+        else:
+            self.cache = model.init_kv_cache(max_sequences, self.max_seq_len)
+            self._step = jax.jit(model.forward_with_cache)
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
     def query(self, uid: int, n_tokens: int) -> bool:
@@ -43,9 +91,19 @@ class InferenceEngineV2:
         for uid in uids:
             seq = self.state.sequences.get(uid)
             if seq is not None:
-                # zero the slot's logical length so the row is reusable
-                self.cache["pos"] = self.cache["pos"].at[seq.slot].set(0)
+                if self.paged:
+                    self._pos[seq.slot] = 0
+                else:
+                    self.cache["pos"] = self.cache["pos"].at[seq.slot].set(0)
             self.state.flush(uid)
+
+    def _block_tables(self) -> np.ndarray:
+        """[max_sequences, nb_max] physical block ids; unused → scratch block."""
+        bt = np.full((self.state.max_sequences, self.nb_max), self.num_blocks,
+                     np.int32)
+        for seq in self.state.sequences.values():
+            bt[seq.slot, :len(seq.blocks)] = seq.blocks
+        return bt
 
     # ---- one continuous-batching step (engine_v2.py:107 parity) ----------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
@@ -66,15 +124,37 @@ class InferenceEngineV2:
         Bs = self.state.max_sequences
         # dense tile: scheduled slots get their chunk (right-padded); others no-op.
         tile = np.zeros((Bs, t_max), np.int32)
+        valid = np.zeros((Bs, t_max), bool)
         for d, c in zip(descs, chunks):
             tile[d.slot, :len(c)] = c
-        logits, new_cache = self._step(self.params, jnp.asarray(tile), self.cache)
+            valid[d.slot, :len(c)] = True
 
-        results: Dict[int, np.ndarray] = {}
+        # next-token logits at each chunk's true end, gathered in ONE device op
+        # + ONE transfer (per-slot python indexing would pay a full dispatch
+        # round-trip per sequence)
+        slots = np.array([d.slot for d in descs], np.int32)
+        ends = np.array([len(c) - 1 for c in chunks], np.int32)
+
+        if self.paged:
+            with jax.sharding.set_mesh(self.mesh):
+                logits, self.cache = self._step(
+                    self.params, jnp.asarray(tile), self.cache,
+                    jnp.asarray(self._block_tables()), jnp.asarray(self._pos),
+                    jnp.asarray(valid))
+                out = np.asarray(logits[jnp.asarray(slots), jnp.asarray(ends)])
+            results: Dict[int, np.ndarray] = {}
+            for i, (d, c) in enumerate(zip(descs, chunks)):
+                results[d.uid] = out[i]
+                self._pos[d.slot] = d.seen_tokens + len(c)
+                self.state.commit(d.uid)
+            return results
+
+        logits, new_cache = self._step(self.params, jnp.asarray(tile), self.cache)
+        out = np.asarray(logits[jnp.asarray(slots), jnp.asarray(ends)])
+        results = {}
         new_pos = np.asarray(self.cache["pos"]).copy()
-        for d, c in zip(descs, chunks):
-            # next-token logits at the chunk's true end (ignore padding)
-            results[d.uid] = np.asarray(logits[d.slot, len(c) - 1])
+        for i, (d, c) in enumerate(zip(descs, chunks)):
+            results[d.uid] = out[i]
             new_pos[d.slot] = d.seen_tokens + len(c)
             self.state.commit(d.uid)
         # padded rows advanced pos by t_max; restore true per-slot positions

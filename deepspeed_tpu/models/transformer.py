@@ -483,6 +483,74 @@ class TransformerLM:
         new_cache = {"k": nk, "v": nv, "pos": pos + t}
         return logits, new_cache
 
+    # ---- paged decode path (blocked KV pool) ------------------------------
+    def init_paged_kv_cache(self, num_blocks: int, block_size: int = 128,
+                            dtype: Optional[Any] = None) -> Dict[str, jax.Array]:
+        """Allocate the global blocked KV pool (inference v2 kv_cache.py parity):
+        ``[L, num_blocks+1, block_size, K, d]`` — the last block is scratch for
+        padded lanes. HBM is proportional to ``num_blocks``, not
+        ``max_sequences × max_seq_len``."""
+        cfg = self.cfg
+        dt = jnp.dtype(dtype or cfg.dtype)
+        shape = (cfg.num_layers, num_blocks + 1, block_size, cfg.num_kv_heads,
+                 cfg.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def forward_with_paged_cache(self, params: Params, input_ids: jax.Array,
+                                 cache: Dict[str, jax.Array],
+                                 block_tables: jax.Array, pos: jax.Array,
+                                 valid: Optional[jax.Array] = None) -> Any:
+        """Continuous-batching step over the blocked KV pool.
+
+        ``input_ids`` [B, t] dense tile (per-slot chunks right-padded);
+        ``block_tables`` int32 [B, nb_max]; ``pos`` int32 [B] tokens already
+        cached per slot; ``valid`` bool [B, t] marks real (non-padding) lanes.
+        Returns (logits [B, t, V], updated cache). Ragged semantics of
+        ``InferenceEngineV2.put`` (engine_v2.py:107) over paged device memory
+        (v2/kernels/ragged_ops/blocked_flash parity).
+        """
+        from deepspeed_tpu.ops.paged_attention import (paged_attention_tp,
+                                                       paged_update)
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, t = input_ids.shape
+        positions = pos[:, None] + jnp.arange(t, dtype=pos.dtype)[None, :]
+        x = params["embed"]["tokens"].astype(dt)[input_ids]
+        if cfg.learned_pos:
+            safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+            x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        freqs = self._freqs
+
+        def body(carry, xs):
+            h = carry
+            layer_w, kp, vp = xs
+            wc = jax.tree_util.tree_map(
+                lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
+            hn = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
+            hd_, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+            q = (hn @ wc["attn"]["wq"]).reshape(B, t, H, hd_)
+            k = (hn @ wc["attn"]["wk"]).reshape(B, t, K, hd_)
+            v = (hn @ wc["attn"]["wv"]).reshape(B, t, K, hd_)
+            if cfg.use_rope:
+                q = apply_rope(q, freqs, positions)
+                k = apply_rope(k, freqs, positions)
+            kp = paged_update(kp, k, block_tables, pos, valid)
+            vp = paged_update(vp, v, block_tables, pos, valid)
+            attn = paged_attention_tp(q, kp, vp, block_tables, pos)
+            h = h + attn.reshape(B, t, H * hd_) @ wc["attn"]["wo"]
+            hn2 = _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps)
+            h = h + mlp_block(hn2, wc["mlp"], cfg)
+            return h, (kp, vp)
+
+        x, (nk, nv) = jax.lax.scan(body, x,
+                                   (params["layers"], cache["k"], cache["v"]))
+        x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = x @ head.astype(dt)
+        return logits, {"k": nk, "v": nv}
+
     # ---- sharding ---------------------------------------------------------
     def param_specs(self) -> Params:
         """Megatron-style TP layout (reference: auto_tp.py row/col policy):
