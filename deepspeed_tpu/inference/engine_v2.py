@@ -75,7 +75,11 @@ class InferenceEngineV2:
             self.cache = jax.device_put(
                 cache, NamedSharding(self.mesh, kv_spec))
             self._pos = np.zeros((max_sequences,), np.int32)
-            self._step = jax.jit(model.forward_with_paged_cache)
+            # donate the pool: the step returns the updated {'k','v'} dict and
+            # self.cache is immediately reassigned — without donation XLA would
+            # double-buffer the whole pool and copy all unchanged blocks
+            self._step = jax.jit(model.forward_with_paged_cache,
+                                 donate_argnums=(2,))
             log_dist(f"paged KV pool: {self.num_blocks} blocks x {block_size} "
                      f"tokens ({self.cache['k'].nbytes * 2 / 1e6:.0f} MB), "
                      f"mesh={self.topology}")
@@ -124,10 +128,8 @@ class InferenceEngineV2:
         Bs = self.state.max_sequences
         # dense tile: scheduled slots get their chunk (right-padded); others no-op.
         tile = np.zeros((Bs, t_max), np.int32)
-        valid = np.zeros((Bs, t_max), bool)
         for d, c in zip(descs, chunks):
             tile[d.slot, :len(c)] = c
-            valid[d.slot, :len(c)] = True
 
         # next-token logits at each chunk's true end, gathered in ONE device op
         # + ONE transfer (per-slot python indexing would pay a full dispatch
@@ -136,6 +138,9 @@ class InferenceEngineV2:
         ends = np.array([len(c) - 1 for c in chunks], np.int32)
 
         if self.paged:
+            valid = np.zeros((Bs, t_max), bool)
+            for d, c in zip(descs, chunks):
+                valid[d.slot, :len(c)] = True
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step(
                     self.params, jnp.asarray(tile), self.cache,

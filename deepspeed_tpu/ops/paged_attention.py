@@ -49,8 +49,11 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 
 def physical_positions(block_tables: jax.Array, positions: jax.Array,
-                       block_size: int, scratch_block: int) -> Tuple[jax.Array, jax.Array]:
-    """Map global token positions [B, t] → (physical block [B, t], offset [B, t])."""
+                       block_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Map global token positions [B, t] → (physical block [B, t], offset [B, t]).
+
+    Out-of-range lanes are the caller's concern: `paged_update` redirects them
+    to the scratch block via its ``valid`` mask."""
     logical = positions // block_size
     logical = jnp.clip(logical, 0, block_tables.shape[1] - 1)
     phys = jnp.take_along_axis(block_tables, logical, axis=1)
@@ -69,7 +72,7 @@ def paged_update(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
     bs = pool.shape[1]
     scratch = pool.shape[0] - 1
     gpos = pos[:, None] + jnp.arange(t, dtype=pos.dtype)[None, :]      # [B, t]
-    phys, off = physical_positions(block_tables, gpos, bs, scratch)
+    phys, off = physical_positions(block_tables, gpos, bs)
     if valid is not None:
         phys = jnp.where(valid, phys, scratch)
     return pool.at[phys, off].set(new.astype(pool.dtype))

@@ -55,7 +55,11 @@ def constrain(x: jax.Array, spec: Optional[P]) -> jax.Array:
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty or not mesh.axis_names:
         return x
-    fspec = filter_spec(spec, mesh.axis_names)
+    # Inside a partial-manual shard_map (the ZeRO++ explicit-collective region),
+    # manual axes are already local — constraints may only name auto axes.
+    manual = set(getattr(mesh, "manual_axes", ()) or ())
+    axis_names = [a for a in mesh.axis_names if a not in manual]
+    fspec = filter_spec(spec, axis_names)
     # Drop axes whose shard count exceeds the dimension size (tiny-test meshes).
     entries = list(fspec)
     for i, entry in enumerate(entries):

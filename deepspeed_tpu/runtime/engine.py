@@ -128,6 +128,7 @@ class DeepSpeedTpuEngine:
                 self._configure_offload_optimizer(off, schedule_fn)
             else:
                 self.opt_state = self._opt_init_fn(self.params)
+        self._refresh_hpz()
         self.scaler_state = self._init_scaler_state()
         self._grad_acc = None
         self._pending = None  # (loss, grads) from the last forward
@@ -168,6 +169,24 @@ class DeepSpeedTpuEngine:
         model, tx = self.module, self.tx
         fp16 = self.fp16_enabled
 
+        from deepspeed_tpu.parallel import zeropp
+
+        self._zpp = None
+        if zeropp.enabled(self.config.zero_optimization):
+            if hasattr(model, "num_stages"):  # pipeline-wrapped
+                raise ValueError("ZeRO++ (qwZ/qgZ/hpZ) does not compose with "
+                                 "pipeline parallelism yet")
+            off = self.config.zero_optimization.offload_optimizer
+            if off is not None and off.device in ("cpu", "nvme"):
+                raise ValueError(
+                    "ZeRO++ (qwZ/qgZ/hpZ) does not compose with "
+                    "offload_optimizer: the fused offload step bypasses the "
+                    "explicit-collective region")
+            self._zpp = zeropp.build_plan(
+                model, self.topology, self.param_spec_tree,
+                self.grad_spec_tree, self.config.zero_optimization)
+        self._hpz_secondary = None
+
         def loss_of(params, batch, scale):
             loss = model.loss_fn(params, batch)
             return loss * scale, loss
@@ -177,10 +196,21 @@ class DeepSpeedTpuEngine:
                 params, batch, scale)
             return loss, grads
 
-        self._fwd_bwd = jax.jit(
-            fwd_bwd,
-            in_shardings=(self.param_sharding, None, self._replicated),
-            out_shardings=(self._replicated, self.grad_sharding))
+        if self._zpp is not None:
+            zpp = self._zpp
+
+            def fwd_bwd_zpp(params_in, batch, scale):
+                grads, loss = zpp.grads_fn(params_in, batch, scale, 1)
+                return loss, grads
+
+            self._fwd_bwd = jax.jit(
+                fwd_bwd_zpp,
+                out_shardings=(self._replicated, self.grad_sharding))
+        else:
+            self._fwd_bwd = jax.jit(
+                fwd_bwd,
+                in_shardings=(self.param_sharding, None, self._replicated),
+                out_shardings=(self._replicated, self.grad_sharding))
 
         def accum(acc, grads):
             return jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -280,8 +310,11 @@ class DeepSpeedTpuEngine:
         """Compute micro-batch loss (and, functionally, its grads) — engine.py:2675."""
         self.tput_timer.start()
         batch = self._put_batch(batch)
+        p_in = (self._hpz_secondary
+                if self._zpp is not None and self._zpp.uses_secondary
+                else self.params)
         with jax.sharding.set_mesh(self.mesh):
-            loss, grads = self._fwd_bwd(self.params, batch, self.scaler_state["scale"])
+            loss, grads = self._fwd_bwd(p_in, batch, self.scaler_state["scale"])
         self._pending = grads
         self._last_loss = loss
         return loss
@@ -345,7 +378,19 @@ class DeepSpeedTpuEngine:
             (self.params, self.opt_state, self.scaler_state, gnorm,
              skipped) = self._apply(self.params, self.opt_state, self._grad_acc,
                                     self.scaler_state)
+        # params are unchanged on an fp16 overflow skip — don't pay the
+        # cross-group gather (only fp16 can skip; the bool() sync already
+        # happens in _commit_step on this path)
+        if not (self.fp16_enabled and bool(skipped)):
+            self._refresh_hpz()
         self._finish_step(gnorm, skipped)
+
+    def _refresh_hpz(self) -> None:
+        """Rebuild the hpZ secondary (intra-node) bf16 param copy from the
+        primary shards — the once-per-step cross-group gather hpZ amortizes."""
+        if self._zpp is not None and self._zpp.uses_secondary:
+            with jax.sharding.set_mesh(self.mesh):
+                self._hpz_secondary = self._zpp.hpz_refresh(self.params)
 
     def _finish_step(self, gnorm, skipped):
         self._grad_acc = None
@@ -421,6 +466,8 @@ class DeepSpeedTpuEngine:
         ga = int(self.config.gradient_accumulation_steps)
         if self._offload is not None:
             return self._fused_offload_step(batch, ga)
+        if self._zpp is not None:
+            return self._fused_zpp_step(batch, ga)
         key = ga
         if key not in self._fused_step_cache:
             def fused(params, opt_state, batch, scaler):
@@ -441,6 +488,43 @@ class DeepSpeedTpuEngine:
         self._last_loss, self._last_gnorm = loss, gnorm
         # only fp16 can skip; reading `skipped` otherwise would force a host
         # sync per step and serialize the dispatch pipeline
+        self._commit_step(self.fp16_enabled and bool(skipped))
+        return loss
+
+    def _fused_zpp_step(self, batch, ga: int):
+        """Fused step through the ZeRO++ explicit-collective region (qwZ/qgZ/
+        hpZ): the quantized gathers/reduces, optimizer, and (for hpZ) the
+        secondary refresh all compile into one XLA program."""
+        zpp = self._zpp
+        key = ("zpp", ga)
+        if key not in self._fused_step_cache:
+            uses_sec = zpp.uses_secondary
+
+            def fused(params, opt_state, batch, scaler, *sec):
+                p_in = sec[0] if uses_sec else params
+                grads, loss = zpp.grads_fn(p_in, batch, scaler["scale"], ga)
+                new_params, new_opt, new_scaler, gnorm, skipped = \
+                    self._apply_body(params, opt_state, grads, scaler, ga=float(ga))
+                out = (new_params, new_opt, new_scaler, loss, gnorm, skipped)
+                if uses_sec:
+                    out += (zpp.hpz_refresh(new_params),)
+                return out
+
+            self._fused_step_cache[key] = jax.jit(
+                fused, donate_argnums=(0, 1, 4) if uses_sec else (0, 1),
+                out_shardings=(self.param_sharding, self.opt_sharding,
+                               None, None, None, None)
+                + ((zpp.hpz_sharding,) if uses_sec else ()))
+        batch = self._put_batch(batch)
+        sec = ((self._hpz_secondary,) if zpp.uses_secondary else ())
+        with jax.sharding.set_mesh(self.mesh):
+            out = self._fused_step_cache[key](
+                self.params, self.opt_state, batch, self.scaler_state, *sec)
+        (self.params, self.opt_state, self.scaler_state, loss, gnorm,
+         skipped) = out[:6]
+        if zpp.uses_secondary:
+            self._hpz_secondary = out[6]
+        self._last_loss, self._last_gnorm = loss, gnorm
         self._commit_step(self.fp16_enabled and bool(skipped))
         return loss
 
@@ -524,5 +608,7 @@ class DeepSpeedTpuEngine:
                         load_optimizer_states: bool = True, **kw):
         from deepspeed_tpu.runtime.checkpoint import load_checkpoint
 
-        return load_checkpoint(self, load_dir, tag=tag,
-                               load_optimizer_states=load_optimizer_states)
+        out = load_checkpoint(self, load_dir, tag=tag,
+                              load_optimizer_states=load_optimizer_states)
+        self._refresh_hpz()  # secondary copy is derived state, not checkpointed
+        return out
