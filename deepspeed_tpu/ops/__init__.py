@@ -97,5 +97,13 @@ def _register_model_attention() -> None:
     tfm.register_attention_impl("flash", flash_or_xla)
     tfm.register_attention_impl("flash_pallas", flash_attention)  # force kernel (tests)
 
+    # sequence-parallel impls: selectable via attention_impl="ulysses"/"ring"
+    # under the engine jit (reference DistributedAttention, sequence/layer.py:351)
+    from deepspeed_tpu.ops.ring_attention import ring_attention_spmd
+    from deepspeed_tpu.sequence.layer import ulysses_attention_spmd
+
+    tfm.register_attention_impl("ulysses", ulysses_attention_spmd)
+    tfm.register_attention_impl("ring", ring_attention_spmd)
+
 
 _register_model_attention()

@@ -73,3 +73,39 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
     (k_f, v_f, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)  # [B, Tl, H, 1]
     return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """``attention_impl="ring"``: engine-selectable context parallelism.
+
+    Self-enters a shard_map manual over ``sp`` (sequence dim sharded, batch and
+    head axes GSPMD-auto) so the model can pick ring attention from inside the
+    engine's jit — the long-context path of BASELINE.md without hand-rolled
+    shard_map at the call site. No head-divisibility constraint (works for any
+    GQA layout). Falls back to dense attention off-mesh."""
+    if segment_ids is not None:
+        raise NotImplementedError("ring attention does not take segment_ids")
+    from deepspeed_tpu.sequence.layer import sp_shard_map
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty:
+        parent_manual = set(getattr(mesh, "manual_axes", ()) or ())
+        sp_live = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+        if sp_live and parent_manual and "sp" not in parent_manual:
+            # XLA cannot yet transpose (differentiate) a ppermute ring nested
+            # inside another manual region — the pipeline's pp shard_map.
+            raise NotImplementedError(
+                "attention_impl='ring' cannot run inside the pipeline region "
+                "(nested-manual ppermute has no transpose); use "
+                "attention_impl='ulysses' when composing sp with pp")
+
+    out = sp_shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=causal),
+        q, k, v)
+    if out is not None:
+        return out
+    from deepspeed_tpu.models.transformer import get_attention_impl
+
+    return get_attention_impl("auto")(q, k, v, causal=causal)

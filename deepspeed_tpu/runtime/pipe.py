@@ -104,6 +104,16 @@ class PipelineModule:
         attn_fn = get_attention_impl(cfg.attention_impl)
         freqs = self.model._freqs
 
+        # XLA's partitioner check-fails when tp-sharded tables (vocab embed,
+        # lm head) are gathered/matmul'd against sp-sharded token arrays inside
+        # the pp manual region. Token ids/labels are tiny — pin every batch
+        # leaf sequence-unsharded here (batch dim left unconstrained); the
+        # attention impls re-enter sp explicitly, so sp composes with pp via
+        # attention_impl="ulysses".
+        U = P.UNCONSTRAINED
+        batch = {k: lax.with_sharding_constraint(
+                     v, P(U, *(None,) * (v.ndim - 1)))
+                 for k, v in batch.items()}
         ids = batch["input_ids"]
         B, T = ids.shape
         if B % M != 0:
@@ -151,8 +161,12 @@ class PipelineModule:
                 state = lax.ppermute(out, "pp", perm)
         outputs = jnp.stack(collected)  # [M, mb, T, D] (valid on the last stage)
 
-        # last stage: final norm + logits + loss over the reassembled batch
-        h = outputs.reshape(B, T, -1)
+        # last stage: final norm + logits + loss over the reassembled batch.
+        # Same partitioner limitation as the ids gather above: the tp-sharded
+        # head matmul on sp-sharded activations check-fails inside the pp
+        # region — pin the sequence dim unsharded for the loss head.
+        h = lax.with_sharding_constraint(outputs.reshape(B, T, -1),
+                                         P(U, None, None))
         h = _norm(h, params["final_norm"], cfg.norm, cfg.norm_eps)
         head = (params["embed"]["tokens"].T if cfg.tie_embeddings
                 else params["lm_head"])

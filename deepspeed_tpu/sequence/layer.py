@@ -57,3 +57,83 @@ class DistributedAttention:
     def __call__(self, query, key, value, *args, causal: bool = True, **kwargs):
         return ulysses_attention(query, key, value, axis=self.axis,
                                  attn_fn=self.local_attn, causal=causal)
+
+
+# ---------------------------------------------------------------------------
+# Engine-reachable SP: attention impls that self-enter the sp manual region.
+# ---------------------------------------------------------------------------
+
+def sp_shard_map(inner: Callable, q: jax.Array, k: jax.Array, v: jax.Array,
+                 axis: str = "sp") -> Optional[jax.Array]:
+    """Run ``inner(q, k, v)`` inside a shard_map that is MANUAL over ``axis``
+    (sequence dim sharded; batch/head axes stay GSPMD-auto), so
+    sequence-parallel attention is selectable from inside the engine's ordinary
+    jit — the registry analog of wrapping a module in ``DistributedAttention``
+    (reference sequence/layer.py:351).
+
+    Returns None when there is no active mesh with a >1 ``axis`` (caller falls
+    back to dense attention). If ``axis`` is already manual (the caller sits
+    inside another shard_map, e.g. a hand-rolled SP region), ``inner`` runs
+    directly on the already-local chunks.
+
+    Inside a parent manual region (the pipeline's pp shard_map), ``tp`` is
+    bound manual as well: XLA's partitioner check-fails when a nested-manual
+    all_to_all splits a dimension that is simultaneously auto-sharded over tp,
+    and heads are embarrassingly parallel anyway.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        return None
+    parent_manual = set(getattr(mesh, "manual_axes", ()) or ())
+    if axis in parent_manual:
+        return inner(q, k, v)
+    from jax.sharding import PartitionSpec as P
+
+    axes = {axis}
+    head_entry = None
+    if parent_manual and "tp" in mesh.axis_names and mesh.shape["tp"] > 1 \
+            and "tp" not in parent_manual:
+        axes.add("tp")
+        head_entry = "tp"
+    spec = P(None, axis, head_entry, None)
+    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=axes,
+                         check_vma=False)(q, k, v)
+
+
+def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                           causal: bool = True,
+                           segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """``attention_impl="ulysses"``: the engine-selectable Ulysses path.
+
+    Heads (and kv heads) must be divisible by the sp axis — same constraint as
+    the reference (sequence/layer.py:246-255); the ``ring`` impl covers the
+    GQA/few-heads regime. Falls back to dense attention when no sp axis is
+    active (single chip, tests off-mesh).
+    """
+    if segment_ids is not None:
+        raise NotImplementedError("ulysses attention does not take segment_ids")
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty and "sp" in mesh.axis_names:
+        sp = mesh.shape["sp"]
+        # inside a parent manual region tp is bound manual too (see
+        # sp_shard_map), so the a2a splits per-tp-shard heads
+        tp = 1
+        if (getattr(mesh, "manual_axes", ()) and "tp" in mesh.axis_names
+                and "tp" not in mesh.manual_axes):
+            tp = mesh.shape["tp"]
+        h, kh = q.shape[2] // tp, max(k.shape[2] // tp, 1)
+        if sp > 1 and (h % sp or kh % sp):
+            raise ValueError(
+                f"ulysses needs num_heads ({q.shape[2]}) and num_kv_heads "
+                f"({k.shape[2]}) (per tp shard) divisible by sp={sp}; use "
+                f"attention_impl='ring' for the GQA/few-heads regime")
+    out = sp_shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis="sp", causal=causal),
+        q, k, v)
+    if out is not None:
+        return out
+    from deepspeed_tpu.models.transformer import get_attention_impl
+
+    return get_attention_impl("auto")(q, k, v, causal=causal)
