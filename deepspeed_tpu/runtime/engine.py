@@ -76,13 +76,28 @@ class DeepSpeedTpuEngine:
             schedule_fn = lr_scheduler
             self.lr_scheduler = LRSchedulerShim(schedule_fn, engine=self)
 
+        from deepspeed_tpu.runtime import onebit
+
         self.client_optimizer = optimizer
-        if optimizer is not None and isinstance(optimizer, optax.GradientTransformation):
+        opt_cfg = config.optimizer
+        self._onebit_name = None
+        if (optimizer is None and opt_cfg is not None
+                and onebit.is_onebit(opt_cfg.type)):
+            # 1-bit optimizers bypass optax: compression + error feedback live
+            # in an explicit-collective region (runtime/onebit.py)
+            self._onebit_name = opt_cfg.type
+            self._schedule_fn = schedule_fn
+            tx = None
+        elif optimizer is not None and isinstance(optimizer, optax.GradientTransformation):
+            if opt_cfg is not None and onebit.is_onebit(opt_cfg.type):
+                raise ValueError(
+                    f"config requests the 1-bit optimizer '{opt_cfg.type}' but "
+                    "a client optax optimizer was passed — dropping to a dense "
+                    "optimizer would silently lose compression; remove one")
             tx = optimizer
             if config.gradient_clipping > 0:
                 tx = optax.chain(optax.clip_by_global_norm(config.gradient_clipping), tx)
         else:
-            opt_cfg = config.optimizer
             name = opt_cfg.type if opt_cfg else "adamw"
             params_cfg = dict(opt_cfg.params) if opt_cfg else {}
             tx = build_optimizer(name, params_cfg, lr_schedule=schedule_fn,
@@ -95,6 +110,7 @@ class DeepSpeedTpuEngine:
             init_rng = jax.random.key(config.seed)
         model_specs = model.param_specs() if hasattr(model, "param_specs") else None
         param_shapes = jax.eval_shape(model.init, init_rng)
+        self._param_shapes = param_shapes
         if model_specs is None:
             model_specs = jax.tree_util.tree_map(lambda _: None, param_shapes)
         zcfg = config.zero_optimization
@@ -106,13 +122,14 @@ class DeepSpeedTpuEngine:
         self.param_sharding = shd.named(self.topology, self.param_spec_tree)
         self.grad_sharding = shd.named(self.topology, self.grad_spec_tree)
 
-        opt_shapes = jax.eval_shape(self.tx.init, param_shapes)
-        opt_param_specs = shd.opt_state_specs(param_shapes, self.param_spec_tree,
-                                              self.topology, self.zero_stage)
-        opt_spec_tree = optax.tree_map_params(
-            self.tx, lambda _leaf, spec: spec, opt_shapes, opt_param_specs,
-            transform_non_params=lambda _leaf: P())
-        self.opt_sharding = shd.named(self.topology, opt_spec_tree)
+        if self.tx is not None:
+            opt_shapes = jax.eval_shape(self.tx.init, param_shapes)
+            opt_param_specs = shd.opt_state_specs(param_shapes, self.param_spec_tree,
+                                                  self.topology, self.zero_stage)
+            opt_spec_tree = optax.tree_map_params(
+                self.tx, lambda _leaf, spec: spec, opt_shapes, opt_param_specs,
+                transform_non_params=lambda _leaf: P())
+            self.opt_sharding = shd.named(self.topology, opt_spec_tree)
         self._replicated = NamedSharding(self.mesh, P())
 
         # ---- compiled functions ---------------------------------------
@@ -170,6 +187,36 @@ class DeepSpeedTpuEngine:
         fp16 = self.fp16_enabled
 
         from deepspeed_tpu.parallel import zeropp
+        from deepspeed_tpu.runtime import onebit
+
+        self._onebit = None
+        if self._onebit_name is not None:
+            off = self.config.zero_optimization.offload_optimizer
+            if hasattr(model, "num_stages"):
+                raise ValueError("1-bit optimizers do not compose with "
+                                 "pipeline parallelism")
+            if off is not None and off.device in ("cpu", "nvme"):
+                raise ValueError("1-bit optimizers do not compose with "
+                                 "offload_optimizer")
+            if zeropp.enabled(self.config.zero_optimization):
+                raise ValueError("1-bit optimizers and ZeRO++ both own the "
+                                 "gradient-reduce region; enable one of them")
+            if self.fp16_enabled:
+                raise NotImplementedError(
+                    "1-bit optimizers run bf16/fp32 here; fp16 loss scaling "
+                    "is not folded into the compressed step")
+            if self.config.gradient_clipping > 0:
+                logger.warning(
+                    "gradient_clipping is not applied in the 1-bit compressed "
+                    "phase (error feedback makes clipped-and-compressed "
+                    "gradients biased); clipping is skipped")
+            self._onebit = onebit.build_plan(
+                model, self.topology, self.param_spec_tree, self._param_shapes,
+                self._onebit_name, dict(self.config.optimizer.params),
+                self.zero_stage, schedule_fn=getattr(self, "_schedule_fn", None))
+            # grads carry a leading device axis in the 1-bit layout
+            self.grad_sharding = self._onebit.grad_sharding
+            self.opt_sharding = self._onebit.state_sharding
 
         self._zpp = None
         if zeropp.enabled(self.config.zero_optimization):
@@ -196,7 +243,20 @@ class DeepSpeedTpuEngine:
                 params, batch, scale)
             return loss, grads
 
-        if self._zpp is not None:
+        if self._onebit is not None:
+            ob = self._onebit
+
+            def fwd_bwd_ob(params, batch, scale):
+                grads, loss = ob.grads_fn(params, batch, scale, 1)
+                return loss, grads
+
+            self._fwd_bwd = jax.jit(
+                fwd_bwd_ob,
+                out_shardings=(self._replicated, self.grad_sharding))
+            self._onebit_apply = jax.jit(
+                ob.apply_fn, donate_argnums=(0, 1, 2),
+                out_shardings=(self.param_sharding, self.opt_sharding, None))
+        elif self._zpp is not None:
             zpp = self._zpp
 
             def fwd_bwd_zpp(params_in, batch, scale):
@@ -248,13 +308,16 @@ class DeepSpeedTpuEngine:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, scaler, gnorm, jnp.zeros((), bool)
 
-        self._apply_body = apply_step
-        self._apply = jax.jit(
-            apply_step, donate_argnums=(0, 1, 2),
-            out_shardings=(self.param_sharding, self.opt_sharding, None, None, None))
-
         self._init_fn = jax.jit(model.init, out_shardings=self.param_sharding)
-        self._opt_init_fn = jax.jit(tx.init, out_shardings=self.opt_sharding)
+        if tx is not None:
+            self._apply_body = apply_step
+            self._apply = jax.jit(
+                apply_step, donate_argnums=(0, 1, 2),
+                out_shardings=(self.param_sharding, self.opt_sharding, None, None, None))
+            self._opt_init_fn = jax.jit(tx.init, out_shardings=self.opt_sharding)
+        else:
+            self._opt_init_fn = jax.jit(self._onebit.init_state,
+                                        out_shardings=self.opt_sharding)
         self._fused_step_cache: Dict[Any, Callable] = {}
 
     # ---- fp16 dynamic loss scaler (loss_scaler.py:187 parity) ----------
@@ -374,6 +437,13 @@ class DeepSpeedTpuEngine:
             self._finish_step(jnp.float32(self._offload._last_gnorm),
                               jnp.asarray(skipped))
             return
+        if self._onebit is not None:
+            denom = jnp.float32(self.config.gradient_accumulation_steps)
+            with jax.sharding.set_mesh(self.mesh):
+                (self.params, self.opt_state, gnorm) = self._onebit_apply(
+                    self.params, self.opt_state, self._grad_acc, denom)
+            self._finish_step(gnorm, jnp.zeros((), bool))
+            return
         with jax.sharding.set_mesh(self.mesh):
             (self.params, self.opt_state, self.scaler_state, gnorm,
              skipped) = self._apply(self.params, self.opt_state, self._grad_acc,
@@ -436,23 +506,11 @@ class DeepSpeedTpuEngine:
     # ---- fused single-jit step (bench / graft path) -------------------
     def _fused_grads(self, params, batch, scale, ga: int):
         """GA scan producing (summed scaled-loss grads, mean loss) — the shared
-        forward/backward half of the fused step."""
-        model = self.module
+        forward/backward half of the fused step (single-sourced with the 1-bit
+        fwd/bwd region in runtime/onebit.py)."""
+        from deepspeed_tpu.runtime.onebit import ga_grads
 
-        def micro(acc, mb):
-            loss, grads = jax.value_and_grad(
-                lambda p, b: model.loss_fn(p, b) * scale)(params, mb)
-            return jax.tree_util.tree_map(jnp.add, acc, grads), loss / scale
-
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        if ga > 1:
-            mbs = jax.tree_util.tree_map(
-                lambda x: x.reshape((ga, x.shape[0] // ga) + x.shape[1:]), batch)
-            grads, losses = jax.lax.scan(micro, zeros, mbs)
-            return grads, losses.mean()
-        grads, loss = micro(zeros, batch)
-        return grads, loss
+        return ga_grads(self.module, params, batch, scale, ga)
 
     def fused_train_step(self, batch):
         """GA loop + apply inside ONE jit: batch leading dim = ga*micro*dp examples.
@@ -466,6 +524,8 @@ class DeepSpeedTpuEngine:
         ga = int(self.config.gradient_accumulation_steps)
         if self._offload is not None:
             return self._fused_offload_step(batch, ga)
+        if self._onebit is not None:
+            return self._fused_onebit_step(batch, ga)
         if self._zpp is not None:
             return self._fused_zpp_step(batch, ga)
         key = ga
@@ -489,6 +549,31 @@ class DeepSpeedTpuEngine:
         # only fp16 can skip; reading `skipped` otherwise would force a host
         # sync per step and serialize the dispatch pipeline
         self._commit_step(self.fp16_enabled and bool(skipped))
+        return loss
+
+    def _fused_onebit_step(self, batch, ga: int):
+        """Fused 1-bit step: local-grad scan + compressed momentum allreduce +
+        update in one XLA program."""
+        ob = self._onebit
+        key = ("onebit", ga)
+        if key not in self._fused_step_cache:
+            def fused(params, opt_state, batch):
+                grads, loss = ob.grads_fn(params, batch, jnp.float32(1.0), ga)
+                new_p, new_s, gnorm = ob.apply_fn(params, opt_state, grads,
+                                                  jnp.float32(ga))
+                return new_p, new_s, loss, gnorm
+
+            self._fused_step_cache[key] = jax.jit(
+                fused, donate_argnums=(0, 1),
+                out_shardings=(self.param_sharding, self.opt_sharding,
+                               None, None))
+        batch = self._put_batch(batch)
+        with jax.sharding.set_mesh(self.mesh):
+            (self.params, self.opt_state, loss,
+             gnorm) = self._fused_step_cache[key](self.params, self.opt_state,
+                                                  batch)
+        self._last_loss, self._last_gnorm = loss, gnorm
+        self._commit_step(False)
         return loss
 
     def _fused_zpp_step(self, batch, ga: int):

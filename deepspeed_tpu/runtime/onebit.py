@@ -1,0 +1,414 @@
+"""1-bit / error-feedback compressed optimizers (OnebitAdam, ZeroOneAdam,
+OnebitLamb).
+
+Parity target: ``deepspeed/runtime/fp16/onebit/{adam,zoadam,lamb}.py`` and the
+compressed allreduce backends (``runtime/comm/compressed.py:14``,
+``nccl.py``). The torch implementations run a two-phase compressed momentum
+allreduce — worker phase: add worker error feedback, sign-compress, all-to-all
+so each rank owns one chunk; server phase: average received chunks, add server
+error feedback, sign-compress, all-gather — with plain dense Adam during a
+warmup window and a frozen variance term afterwards.
+
+TPU-native design: the same algorithm, expressed as explicit collectives in a
+``shard_map`` manual over the data-parallel axis (GSPMD cannot emit lossy
+collectives — same reasoning as ``parallel/zeropp.py``):
+
+* the engine's fwd/bwd region outputs UNREDUCED per-device gradients as global
+  arrays with a leading device axis (``[W, ...]`` sharded ``P(dp)``) — the
+  manual analog of the reference's hook-free local ``.grad`` buffers;
+* the optimizer region is manual over (dp|fsdp) AND tp, so every leaf is fully
+  local and compression is pure element-wise math; signs travel as genuinely
+  1-bit payloads (``jnp.packbits`` → uint8 lanes, 8 signs/byte) plus one fp32
+  scale per chunk;
+* worker/server error-feedback buffers are sized from the LOCAL (tp-sharded)
+  leaf and stored with an explicit ``[W, tp, n_local]`` device layout, so the
+  sharding metadata tells the truth about their per-device contents;
+* after warmup there is NO dense gradient collective at all: the averaged
+  gradient that feeds the variance term is recovered from the momentum
+  recurrence (``g_avg = (m_avg - b1*m)/(1-b1)``), and the grad-norm is a
+  scalar psum — total per-step wire volume is 2 bits/element.
+
+Simplifications vs the reference (kept honest in PARITY.md): ZeroOneAdam here
+is 1-bit Adam with a longer variance-update window (``var_freeze_step``); its
+exponentially-spaced variance schedule and local-step communication skipping
+are not replicated.
+
+Stage restriction (same as the reference, onebit/adam.py docstring): ZeRO
+stage <= 1 — grads must be whole-tensor per device for local momentum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.sharding import spec_axes
+from deepspeed_tpu.utils.logging import log_dist
+
+ONEBIT_NAMES = ("onebitadam", "zerooneadam", "onebitlamb")
+
+# leaves smaller than this stay on the dense pmean path (compression overhead
+# and padding waste dominate; reference fuses small tensors for the same reason)
+DENSE_THRESHOLD = 4096
+
+
+def canonical_name(name: str) -> str:
+    return name.lower().replace("_", "").replace("-", "")
+
+
+def is_onebit(name: str) -> bool:
+    return canonical_name(name) in ONEBIT_NAMES
+
+
+def ga_grads(model, params, batch, scale, ga: int):
+    """Per-device gradient-accumulation scan: summed grads of ``loss*scale``
+    over ``ga`` microbatches + mean loss. Shared by the engine's fused step
+    and the 1-bit fwd/bwd region so the accumulation semantics stay single-
+    sourced."""
+
+    def micro(acc, mb):
+        loss, g = jax.value_and_grad(
+            lambda p: model.loss_fn(p, mb) * scale)(params)
+        return jax.tree_util.tree_map(jnp.add, acc, g), loss / scale
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    if ga > 1:
+        mbs = jax.tree_util.tree_map(
+            lambda x: x.reshape((ga, x.shape[0] // ga) + x.shape[1:]), batch)
+        grads, losses = lax.scan(micro, zeros, mbs)
+        return grads, losses.mean()
+    return micro(zeros, batch)
+
+
+# ---------------------------------------------------------------------------
+# sign compression + two-phase compressed allreduce (compressed.py parity)
+# ---------------------------------------------------------------------------
+
+def _sign_compress(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """x [..., n] → (packed uint8 [..., n/8], scale [..., 1]).
+
+    scale = mean |x| keeps the decompressed magnitude unbiased (the reference's
+    ``myIgather``-side scale in compressed_allreduce)."""
+    scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+    bits = (x >= 0)
+    packed = jnp.packbits(bits, axis=-1)
+    return packed, scale
+
+
+def _sign_decompress(packed: jax.Array, scale: jax.Array, n: int) -> jax.Array:
+    bits = jnp.unpackbits(packed, axis=-1, count=n)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0) * scale
+
+
+def compressed_allreduce(x: jax.Array, e_w: jax.Array, e_s: jax.Array,
+                         axis: str) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Error-feedback sign-compressed mean over ``axis`` (manual region).
+
+    ``x``/``e_w`` flat [n] (n % (W*8) == 0), ``e_s`` flat [n/W]. Returns
+    (averaged [n], new worker error [n], new server error [n/W]). Two phases on
+    the wire: a2a of n/8 bytes + all_gather of n/8 bytes — 1 bit per element
+    per phase, the reference's compressed_allreduce layout."""
+    W = lax.axis_size(axis)
+    n = x.shape[0]
+    c = x + e_w
+    chunks = c.reshape(W, n // W)
+    packed, scale = _sign_compress(chunks)
+    # worker error: what compression lost, locally
+    e_w_new = (c - _sign_decompress(packed, scale, n // W).reshape(n))
+    # each rank receives every worker's version of ITS chunk
+    recv_p = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0, tiled=True)
+    recv_s = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+    mine = _sign_decompress(recv_p, recv_s, n // W).mean(axis=0)  # [n/W]
+    # server phase: error-feed, compress, share
+    s = mine + e_s
+    packed2, scale2 = _sign_compress(s[None])
+    e_s_new = s - _sign_decompress(packed2, scale2, n // W)[0]
+    all_p = lax.all_gather(packed2[0], axis, axis=0, tiled=False)   # [W, n/8W]
+    all_s = lax.all_gather(scale2[0], axis, axis=0, tiled=False)    # [W, 1]
+    out = _sign_decompress(all_p, all_s, n // W).reshape(n)
+    return out, e_w_new, e_s_new
+
+
+# ---------------------------------------------------------------------------
+# the optimizer plan
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OnebitPlan:
+    """Engine-facing bundle: fwd/bwd + apply programs and state layouts."""
+
+    comm_axis: str
+    batch_axes: Tuple[str, ...]
+    grads_fn: Callable          # (params, batch, scale, ga) -> (grads[W,...], loss)
+    init_state: Callable        # (params) -> opt_state pytree
+    apply_fn: Callable          # (params, state, grads, denom) -> (params, state, gnorm)
+    grad_sharding: Any          # NamedSharding tree for the [W,...] grads
+    state_sharding: Any         # NamedSharding tree for the optimizer state
+
+
+def _restrict(spec: Optional[P], keep) -> P:
+    entries = []
+    for e in (spec or ()):
+        kept = tuple(a for a in spec_axes(e) if a in keep)
+        entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
+               opt_params: Dict[str, Any], zero_stage: int,
+               schedule_fn: Optional[Callable] = None) -> OnebitPlan:
+    """Build the 1-bit optimizer step for this mesh/model.
+
+    Raises (reference parity, onebit/adam.py asserts the same constraints):
+      * zero_stage > 1
+      * both dp and fsdp > 1 (compression needs ONE data-parallel comm axis)
+      * ep > 1 (expert-parallel param shards would need per-group exchanges)
+    """
+    kind = canonical_name(opt_name)
+    assert kind in ONEBIT_NAMES
+    if zero_stage > 1:
+        raise ValueError(f"{opt_name} supports ZeRO stage <= 1 (got stage="
+                         f"{zero_stage}) — same restriction as the reference")
+    dp, fsdp = topology.axis_sizes.get("dp", 1), topology.axis_sizes.get("fsdp", 1)
+    if dp > 1 and fsdp > 1:
+        raise ValueError(
+            "1-bit optimizers need a single data-parallel comm axis; fold dp "
+            "and fsdp into one (mesh {'dp': N} or {'fsdp': N})")
+    if topology.axis_sizes.get("ep", 1) > 1:
+        raise ValueError("1-bit optimizers do not compose with expert "
+                         "parallelism (ep > 1)")
+    comm_axis = "dp" if dp > 1 else "fsdp"
+    W = max(dp, fsdp)
+    mesh = topology.mesh
+    batch_axes = (comm_axis,) if W > 1 else ()
+
+    lr = float(opt_params.get("lr", 1e-3))
+    b1, b2 = tuple(opt_params.get("betas", (0.9, 0.999)))
+    eps = float(opt_params.get("eps", 1e-8))
+    wd = float(opt_params.get("weight_decay", 0.0))
+    freeze_step = int(opt_params.get("freeze_step", 100))
+    var_freeze = int(opt_params.get("var_freeze_step",
+                                    freeze_step if kind == "onebitadam"
+                                    else 4 * freeze_step))
+
+    manual = set(batch_axes)
+    tp = topology.axis_sizes.get("tp", 1)
+    opt_manual = set(manual)
+    if tp > 1:
+        opt_manual.add("tp")  # optimizer math is element-wise: make leaves fully local
+
+    pspecs = param_spec_tree
+
+    def _shape(p):
+        """Shape of an array or jax.ShapeDtypeStruct leaf."""
+        return tuple(getattr(p, "shape", np.shape(p)))
+
+    def _tp_factor(spec) -> int:
+        if tp <= 1:
+            return 1
+        return tp if any("tp" in spec_axes(e) for e in (spec or ())) else 1
+
+    def _local_n(p, spec) -> int:
+        return int(np.prod(_shape(p))) // _tp_factor(spec)
+
+    def _pad_len(n: int) -> int:
+        q = max(W, 1) * 8
+        return -(-n // q) * q
+
+    # ---- fwd/bwd: local grads with a leading device axis ----------------
+    def grads_fn(params, batch, scale, ga: int):
+        if not manual:  # single device — dense path, same layout
+            grads, loss = ga_grads(model, params, batch, scale, ga)
+            return jax.tree_util.tree_map(lambda g: g[None], grads), loss
+        in_p = jax.tree_util.tree_map(lambda s: _restrict(s, manual), pspecs,
+                                      is_leaf=lambda s: s is None)
+        bspecs = jax.tree_util.tree_map(lambda _: P(comm_axis), batch)
+        out_g = jax.tree_util.tree_map(
+            lambda s: P(comm_axis, *_restrict(s, manual)), pspecs,
+            is_leaf=lambda s: s is None)
+
+        def body(params, batch, scale):
+            grads, loss = ga_grads(model, params, batch, scale, ga)
+            loss = lax.pmean(loss, tuple(manual))
+            return jax.tree_util.tree_map(lambda g: g[None], grads), loss
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(in_p, bspecs, P()),
+                             out_specs=(out_g, P()), axis_names=manual,
+                             check_vma=False)(params, batch, scale)
+
+    # ---- optimizer state ------------------------------------------------
+    def _uses_comm(p) -> bool:
+        return int(np.prod(_shape(p))) >= DENSE_THRESHOLD and W > 1
+
+    def init_state(params):
+        m = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(np.shape(p), jnp.float32), params)
+        v = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(np.shape(p), jnp.float32), params)
+
+        def err(p, spec):
+            if not _uses_comm(p):
+                return jnp.zeros((W, 1, 1), jnp.float32)
+            t = _tp_factor(spec)
+            return jnp.zeros((W, t, _pad_len(_local_n(p, spec))), jnp.float32)
+
+        def err_s(p, spec):
+            if not _uses_comm(p):
+                return jnp.zeros((W, 1, 1), jnp.float32)
+            t = _tp_factor(spec)
+            return jnp.zeros((W, t, _pad_len(_local_n(p, spec)) // W),
+                             jnp.float32)
+
+        e_w = jax.tree_util.tree_map(err, params, pspecs)
+        e_s = jax.tree_util.tree_map(err_s, params, pspecs)
+        return {"m": m, "v": v, "e_w": e_w, "e_s": e_s,
+                "step": jnp.zeros((), jnp.int32)}
+
+    # ---- the apply region (manual over comm axis + tp) ------------------
+    def _apply_local(params, state, grads, denom):
+        """All leaves fully local (manual over comm+tp). grads leading axis
+        already stripped. Returns (params, state, gnorm)."""
+        step = state["step"] + 1
+        compressed_phase = step > freeze_step
+        lr_now = (lr if schedule_fn is None else schedule_fn(state["step"]))
+        gnorm_sq_parts = []
+
+        def leaf_update(p, g, m, v, ew, es):
+            g = g.astype(jnp.float32) / denom
+            nloc = int(np.prod(g.shape))  # LOCAL size (tp-manual region)
+            use_comm = ew.shape[-1] > 1 and W > 1
+            m_new = b1 * m + (1 - b1) * g
+            if use_comm:
+                flat = m_new.ravel()
+                pad = ew.shape[-1] - nloc
+                flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+                def comp(args):
+                    f, w, s = args
+                    return compressed_allreduce(f, w, s, comm_axis)
+
+                def dense(args):
+                    f, w, s = args
+                    return lax.pmean(f, comm_axis), w, s
+
+                out, ew2, es2 = lax.cond(compressed_phase, comp, dense,
+                                         (flat, ew[0, 0], es[0, 0]))
+                m_avg = out[:nloc].reshape(g.shape)
+                ew2, es2 = ew2[None, None], es2[None, None]
+            else:
+                m_avg = lax.pmean(m_new, comm_axis) if W > 1 else m_new
+                ew2, es2 = ew, es
+            # averaged gradient recovered from the momentum recurrence — no
+            # second dense collective (m is replicated across the comm axis)
+            g_avg = (m_avg - b1 * m) / (1 - b1)
+            gnorm_sq_parts.append(jnp.sum(jnp.square(g_avg)))
+            v_new = jnp.where(step <= var_freeze,
+                              b2 * v + (1 - b2) * jnp.square(g_avg), v)
+            # standard adam bias correction, with the variance term pinned at
+            # its freeze point (onebit adam freezes v after warmup)
+            bc1 = 1 - b1 ** step.astype(jnp.float32)
+            bc2 = 1 - b2 ** jnp.minimum(step, var_freeze).astype(jnp.float32)
+            u = (m_avg / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd > 0:
+                u = u + wd * p
+            if kind == "onebitlamb":
+                pn = jnp.linalg.norm(p)
+                un = jnp.linalg.norm(u)
+                trust = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+                u = trust * u
+            return p - lr_now * u, m_avg, v_new, ew2, es2
+
+        out = jax.tree_util.tree_map(
+            leaf_update, params, grads, state["m"], state["v"], state["e_w"],
+            state["e_s"])
+        gnorm_sq = sum(gnorm_sq_parts)
+        if "tp" in opt_manual:
+            gnorm_sq = lax.psum(gnorm_sq, "tp")  # scalar — negligible traffic
+        gnorm = jnp.sqrt(gnorm_sq)
+        split = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
+        return split(0), {"m": split(1), "v": split(2), "e_w": split(3),
+                          "e_s": split(4), "step": step}, gnorm
+
+    def apply_fn(params, state, grads, denom):
+        """grads: [W, ...] leading-device-axis tree; denom = loss_scale * ga."""
+        if not manual:
+            return _apply_local(
+                params, state, jax.tree_util.tree_map(lambda g: g[0], grads),
+                denom)
+
+        in_p = jax.tree_util.tree_map(lambda s: _restrict(s, opt_manual), pspecs,
+                                      is_leaf=lambda s: s is None)
+        in_g = jax.tree_util.tree_map(
+            lambda s: P(comm_axis, *_restrict(s, opt_manual)), pspecs,
+            is_leaf=lambda s: s is None)
+
+        err_specs = jax.tree_util.tree_map(_err_spec, param_shapes, pspecs)
+        state_specs = {
+            "m": in_p, "v": jax.tree_util.tree_map(lambda s: s, in_p),
+            "e_w": err_specs,
+            "e_s": jax.tree_util.tree_map(lambda s: s, err_specs),
+            "step": P(),
+        }
+
+        def body(params, state, grads, denom):
+            grads = jax.tree_util.tree_map(lambda g: g[0], grads)
+            return _apply_local(params, state, grads, denom)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(in_p, state_specs, in_g, P()),
+            out_specs=(in_p, state_specs, P()),
+            axis_names=opt_manual, check_vma=False)(params, state, grads, denom)
+
+    def _err_spec(p, s):
+        """Device layout of an error buffer [W, tp, n]: the tp axis only when
+        the leaf is big enough for the comm path AND tp-sharded (small dense-
+        path buffers have a size-1 middle dim)."""
+        if not _uses_comm(p) or _tp_factor(s) <= 1:
+            return P(comm_axis if manual else None, None)
+        return P(comm_axis if manual else None, "tp")
+
+    # ---- shardings ------------------------------------------------------
+    grad_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P(comm_axis if manual else None,
+                                        *(s or P()))),
+        pspecs, is_leaf=lambda s: s is None or isinstance(s, P))
+    psh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else P()),
+        pspecs, is_leaf=lambda s: s is None or isinstance(s, P))
+
+    err_sh = jax.tree_util.tree_map(
+        lambda p, s: NamedSharding(mesh, _err_spec(p, s)), param_shapes, pspecs)
+    state_sharding = {
+        "m": psh, "v": jax.tree_util.tree_map(lambda x: x, psh),
+        "e_w": err_sh, "e_s": jax.tree_util.tree_map(lambda x: x, err_sh),
+        "step": NamedSharding(mesh, P()),
+    }
+    log_dist(f"1-bit optimizer {kind}: comm_axis={comm_axis} W={W} "
+             f"freeze_step={freeze_step} var_freeze={var_freeze}")
+    if schedule_fn is not None:
+        # sign compression gives zero-momentum elements magnitude mean|m|; if
+        # the variance was frozen while the LR warmup kept grads (and thus v)
+        # at zero, those elements blow up as scale/eps. Same guidance as the
+        # reference docs: freeze_step must come AFTER the LR warmup window.
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            "%s with an LR schedule: set freeze_step (%d) to at least the end "
+            "of the LR warmup window, or the frozen variance term will be "
+            "unpopulated and the compressed phase can diverge",
+            kind, freeze_step)
+    return OnebitPlan(comm_axis=comm_axis, batch_axes=tuple(batch_axes),
+                      grads_fn=grads_fn, init_state=init_state,
+                      apply_fn=apply_fn, grad_sharding=grad_sharding,
+                      state_sharding=state_sharding)

@@ -94,14 +94,21 @@ def build_optimizer(name: str, params_cfg: Dict[str, Any],
     wd = p.pop("weight_decay", 0.0)
     name = name.lower().replace("_", "").replace("-", "")
 
-    if name in ("adam", "fusedadam", "adamw", "cpuadam", "onebitadam", "zerooneadam"):
+    if name in ("onebitadam", "zerooneadam", "onebitlamb"):
+        # never a silent dense fallback: the engine routes these to the
+        # compressed error-feedback implementation (runtime/onebit.py)
+        raise ValueError(
+            f"'{name}' is a 1-bit compressed optimizer and must be selected "
+            "through the engine config (deepspeed_tpu.initialize), not "
+            "build_optimizer — the compression lives in the train step")
+    if name in ("adam", "fusedadam", "adamw", "cpuadam"):
         decoupled = name != "adam" or p.pop("adam_w_mode", True)
         tx = (optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
               if decoupled else
               optax.chain(optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
                           optax.add_decayed_weights(wd),
                           optax.scale_by_learning_rate(lr)))
-    elif name in ("lamb", "fusedlamb", "onebitlamb"):
+    elif name in ("lamb", "fusedlamb"):
         tx = _lamb(lr, betas=betas, eps=eps, weight_decay=wd)
     elif name in ("lion", "fusedlion"):
         tx = optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=wd)
