@@ -122,6 +122,16 @@ class OffloadOptimizerConfig(DSTpuConfigModel):
     ratio: float = 1.0
 
 
+class ZenFlowConfig(DSTpuConfigModel):
+    """``zero_optimization.zenflow`` (reference ``runtime/zenflow/``):
+    asynchronous host-offload updates that overlap the accelerator's next
+    step. Here ``overlap_step`` runs the whole host Adam step in a background
+    worker with 1-step bounded staleness (the reference's importance-based
+    top-k gradient split is not replicated — all grads take the async path)."""
+
+    overlap_step: bool = True
+
+
 class ZeroConfig(DSTpuConfigModel):
     """``zero_optimization`` section (reference: ``deepspeed/runtime/zero/config.py:90``).
 
@@ -143,6 +153,7 @@ class ZeroConfig(DSTpuConfigModel):
     overlap_comm: Optional[bool] = None
     offload_param: Optional[OffloadParamConfig] = None
     offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    zenflow: Optional[ZenFlowConfig] = None
     sub_group_size: int = 1_000_000_000
     # params smaller than this stay replicated (Z3 persistence threshold parity,
     # stage3.py param_persistence_threshold)

@@ -1,5 +1,5 @@
 """Host-offloaded optimizer step (ZeRO-Offload) with optional NVMe state tier
-(ZeRO-Infinity).
+(ZeRO-Infinity) and ZenFlow-style asynchronous overlap.
 
 Parity target: ``runtime/zero/stage_1_and_2.py``/``stage3.py`` with
 ``offload_optimizer.device=cpu|nvme`` + ``swap_tensor/partitioned_optimizer_swapper``:
@@ -9,11 +9,21 @@ engine routes ``step()`` here instead of the jitted optax apply.
 
 NVMe pipelining mirrors ``pipelined_optimizer_swapper.py``: while leaf *i* updates,
 leaf *i+1*'s moments are already being read and leaf *i-1*'s are being written.
+
+Overlap (``zero_optimization.zenflow``, reference ``runtime/zenflow/
+zenflow_stage_1_and_2.py:47``): ``step_async`` snapshots grads with
+``copy_to_host_async`` and runs the whole host step (D2H wait → C++ Adam →
+H2D upload) on a background worker, so it overlaps the accelerator's next
+forward/backward; the engine applies the result at the NEXT step boundary —
+1-step bounded staleness, the decoupling ZenFlow exists for. Each C++ Adam
+call already spreads across host cores (omp parallel for), so leaves update
+sequentially without oversubscription.
 """
 
 from __future__ import annotations
 
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -22,6 +32,24 @@ import numpy as np
 from deepspeed_tpu.offload.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.offload.swap import AsyncTensorSwapper
 from deepspeed_tpu.utils.logging import log_dist
+
+
+def _aliasing_backend() -> bool:
+    """On the CPU backend jax device_get/device_put can alias host numpy
+    buffers (zero-copy) instead of copying — the in-place C++ Adam would then
+    mutate live param/grad device arrays. Force copies there; on TPU the
+    host↔HBM transfer is a real copy already."""
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True
+
+
+def _host_copy(leaf) -> np.ndarray:
+    arr = np.asarray(jax.device_get(leaf), np.float32)
+    if _aliasing_backend():
+        arr = arr.copy()
+    return np.ascontiguousarray(arr)
 
 
 def _leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
@@ -37,12 +65,17 @@ class HostOffloadOptimizer:
     def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  gradient_clipping: float = 0.0, schedule_fn=None,
-                 nvme_path: Optional[str] = None, aio_threads: int = 2):
+                 nvme_path: Optional[str] = None, aio_threads: int = 2,
+                 overlap_step: bool = False):
         self.adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
                                      weight_decay=weight_decay)
         self.schedule_fn = schedule_fn
         self.base_lr = lr
         self.gradient_clipping = gradient_clipping
+        self.overlap = overlap_step
+        self._worker = ThreadPoolExecutor(max_workers=1) if overlap_step else None
+        self._pending = None  # in-flight Future from step_async
+        self._last_gnorm = float("nan")
         self.swapper = (AsyncTensorSwapper(os.path.join(nvme_path, "opt_states"),
                                            num_threads=aio_threads)
                         if nvme_path else None)
@@ -51,10 +84,9 @@ class HostOffloadOptimizer:
         self.m: Dict[str, np.ndarray] = {}
         self.v: Dict[str, np.ndarray] = {}
         for name, leaf in _leaf_paths(params):
-            host = np.asarray(jax.device_get(leaf), np.float32)
-            self.master[name] = np.ascontiguousarray(host)
-            m = np.zeros_like(host)
-            v = np.zeros_like(host)
+            self.master[name] = _host_copy(leaf)
+            m = np.zeros_like(self.master[name])
+            v = np.zeros_like(self.master[name])
             if self.swapper is not None:
                 self.swapper.swap_out(name + ".m", m)
                 self.swapper.swap_out(name + ".v", v)
@@ -72,22 +104,42 @@ class HostOffloadOptimizer:
 
         ``skipped=True`` (non-finite grad norm, fp16 overflow) leaves every state
         untouched — the engine keeps its params and shrinks the loss scale."""
-        lr = float(self.schedule_fn(step_num)) if self.schedule_fn else self.base_lr
+        host_grads, order = self._snapshot_grads(grads)
+        skipped = self._host_work(host_grads, order, step_num)
+        if skipped:
+            return params, True
+        return self._upload(params), False
+
+    def _snapshot_grads(self, grads):
+        """D2H of the grad tree (main thread — the jax client is not touched
+        from the worker). copy_to_host_async first so leaf transfers overlap
+        each other."""
         names_leaves = _leaf_paths(grads)
+        for _, g in names_leaves:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
         host_grads = {n: np.asarray(jax.device_get(g), np.float32)
                       for n, g in names_leaves}
+        return host_grads, [n for n, _ in names_leaves]
 
+    def _host_work(self, host_grads, order, step_num) -> bool:
+        """gnorm + clip + fused Adam over the host buffers (pure numpy/C++ —
+        safe on the background worker). Returns skipped."""
+        lr = float(self.schedule_fn(step_num)) if self.schedule_fn else self.base_lr
         gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
                                   for g in host_grads.values())))
         self._last_gnorm = gnorm
         if not np.isfinite(gnorm):
-            return params, True
+            return True
         if self.gradient_clipping > 0 and gnorm > self.gradient_clipping:
             scale = self.gradient_clipping / (gnorm + 1e-6)
-            for g in host_grads.values():
-                g *= scale
+            # fresh arrays: host_grads may alias the live device buffers
+            host_grads = {n: g * scale for n, g in host_grads.items()}
+        self._run_adam(host_grads, order, lr)
+        return False
 
-        order = [n for n, _ in names_leaves]
+    def _run_adam(self, host_grads: Dict[str, np.ndarray], order: List[str],
+                  lr: float) -> None:
         self.adam.step_count += 1
         if self.swapper is not None:
             # pipelined: prefetch next moments while updating current
@@ -109,26 +161,64 @@ class HostOffloadOptimizer:
                     m_cur, v_cur = m_nxt, v_nxt
             self.swapper.wait()
         else:
+            # sequential per leaf: the C++ kernel already spreads each call
+            # across all host cores (omp parallel for in csrc/cpu_adam.cpp)
             for name in order:
                 self.adam.step(self.master[name].reshape(-1),
                                host_grads[name].reshape(-1),
                                self.m[name].reshape(-1), self.v[name].reshape(-1),
                                lr=lr, increment=False)
 
-        # masters → device, preserving each leaf's sharding + dtype
+    def _upload(self, params: Any):
+        """masters → device, preserving each leaf's sharding + dtype."""
         leaves = dict(_leaf_paths(params))
+        copy = _aliasing_backend()  # device_put must not alias the mutable master
         new_flat = {}
         for name, leaf in leaves.items():
-            arr = self.master[name].astype(np.asarray(leaf).dtype, copy=False)
+            arr = self.master[name].astype(leaf.dtype, copy=copy)
             new_flat[name] = jax.device_put(arr.reshape(leaf.shape), leaf.sharding)
         treedef = jax.tree_util.tree_structure(params)
         ordered = [new_flat[n] for n, _ in _leaf_paths(params)]
-        return jax.tree_util.tree_unflatten(treedef, ordered), False
+        return jax.tree_util.tree_unflatten(treedef, ordered)
+
+    # ------------------------------------------------------------------
+    # ZenFlow overlap: async step with 1-step bounded staleness
+    # ------------------------------------------------------------------
+    def step_async(self, grads: Any, params: Any, step_num: int) -> None:
+        """Launch the host Adam in the background; the result is collected by
+        :meth:`finish_pending` (the engine calls it at the next step boundary,
+        so gnorm/clip/Adam overlap the accelerator's next fwd/bwd).
+
+        Only the pure numpy/C++ work moves to the worker — the D2H snapshot
+        happens here and the H2D upload at collect time, both on the caller's
+        thread, because concurrent jax-client use from a second thread
+        serializes badly against the main dispatch stream."""
+        assert self._pending is None, "previous async step not collected"
+        host_grads, order = self._snapshot_grads(grads)
+        fut = self._worker.submit(self._host_work, host_grads, order, step_num)
+        self._pending = (fut, params)
+
+    def finish_pending(self):
+        """Block on the in-flight async step; returns (new_params, skipped) or
+        None when nothing is pending. Must be called before reading params for
+        checkpointing/eval (the engine does)."""
+        if self._pending is None:
+            return None
+        fut, params = self._pending
+        skipped = fut.result()
+        self._pending = None
+        if skipped:
+            return params, True
+        return self._upload(params), False
 
     # ------------------------------------------------------------------
     def state_dict(self) -> Dict[str, np.ndarray]:
+        assert self._pending is None, (
+            "flush the async step (engine.step boundary) before checkpointing")
         out = {"step": np.int64(self.adam.step_count)}
         for name in self.master:
+            # no copy: _pending is drained (asserted above) and the caller
+            # writes synchronously, so no later step can race this snapshot
             out["master/" + name] = self.master[name]
             if self.swapper is not None:
                 out["m/" + name] = self.swapper.swap_in(name + ".m")
@@ -145,7 +235,7 @@ class HostOffloadOptimizer:
                 continue
             kind, name = key.split("/", 1)
             if kind == "master":
-                self.master[name] = np.ascontiguousarray(val, np.float32)
+                self.master[name] = np.array(val, np.float32)  # owned copy
             elif self.swapper is not None:
                 self.swapper.swap_out(name + "." + kind, np.ascontiguousarray(val))
             else:

@@ -138,6 +138,11 @@ class DeepSpeedTpuEngine:
         # ---- materialize state ----------------------------------------
         self._offload = None
         off = zcfg.offload_optimizer
+        if zcfg.zenflow is not None and (off is None
+                                         or off.device not in ("cpu", "nvme")):
+            raise ValueError(
+                "zero_optimization.zenflow requires offload_optimizer "
+                "(device cpu|nvme) — there is no host step to overlap")
         with jax.sharding.set_mesh(self.mesh):
             self.params = self._init_fn(init_rng)
             if off is not None and off.device in ("cpu", "nvme"):
@@ -402,9 +407,17 @@ class DeepSpeedTpuEngine:
         return self._grad_acc_count >= int(self.config.gradient_accumulation_steps)
 
     def _configure_offload_optimizer(self, off, schedule_fn) -> None:
-        """ZeRO-Offload/Infinity path (engine.py:1960 CPUAdam selection parity)."""
+        """ZeRO-Offload/Infinity path (engine.py:1960 CPUAdam selection parity);
+        ``zero_optimization.zenflow`` turns on the asynchronous overlap step."""
         from deepspeed_tpu.offload import HostOffloadOptimizer
 
+        zf = self.config.zero_optimization.zenflow
+        overlap = bool(zf is not None and zf.overlap_step)
+        if overlap and self.fp16_enabled:
+            raise NotImplementedError(
+                "zenflow.overlap_step needs the overflow-skip decision at step "
+                "time; it does not compose with fp16 dynamic loss scaling "
+                "(use bf16)")
         p = dict(self.config.optimizer.params) if self.config.optimizer else {}
         self._offload = HostOffloadOptimizer(
             self.params,
@@ -413,7 +426,7 @@ class DeepSpeedTpuEngine:
             gradient_clipping=self.config.gradient_clipping,
             schedule_fn=schedule_fn,
             nvme_path=off.nvme_path if off.device == "nvme" else None,
-            aio_threads=off.buffer_count)
+            aio_threads=off.buffer_count, overlap_step=overlap)
 
     def step(self, *args, **kwargs):
         """Optimizer step at the GA boundary — engine.py:3241."""
@@ -425,6 +438,15 @@ class DeepSpeedTpuEngine:
             with jax.sharding.set_mesh(self.mesh):
                 grads = (self._grad_acc if denom == 1.0 else jax.tree_util.tree_map(
                     lambda g: g / denom, self._grad_acc))
+            if self._offload.overlap:
+                self._collect_offload()
+                # snapshot BEFORE launching: the worker overwrites _last_gnorm
+                gnorm_prev = jnp.float32(self._offload._last_gnorm)
+                self._offload.step_async(grads, self.params, self.global_steps)
+                # gnorm/skip reporting lags one step by design (ZenFlow's
+                # bounded staleness); bf16-only so skips are inf-grad rare
+                self._finish_step(gnorm_prev, jnp.zeros((), bool))
+                return
             new_params, skipped = self._offload.step(grads, self.params,
                                                      self.global_steps)
             if not skipped:
@@ -454,6 +476,22 @@ class DeepSpeedTpuEngine:
         if not (self.fp16_enabled and bool(skipped)):
             self._refresh_hpz()
         self._finish_step(gnorm, skipped)
+
+    def _collect_offload(self) -> None:
+        """Apply the previous async offload step's params (ZenFlow overlap:
+        the host Adam of step N-1 ran during step N's fwd/bwd)."""
+        prev = self._offload.finish_pending()
+        if prev is not None:
+            new_params, skipped = prev
+            if not skipped:
+                self.params = new_params
+            else:
+                # the launch-time _commit_step already counted this as a
+                # successful step; restate it as skipped so the counters
+                # match the synchronous path (the one LR-schedule tick it
+                # took is not unwound — bounded, and skips are rare in bf16)
+                self.skipped_steps += 1
+                self.global_steps = max(0, self.global_steps - 1)
 
     def _refresh_hpz(self) -> None:
         """Rebuild the hpZ secondary (intra-node) bf16 param copy from the
@@ -630,6 +668,14 @@ class DeepSpeedTpuEngine:
         with jax.sharding.set_mesh(self.mesh):
             grads, loss = self._fused_step_cache[key](
                 self.params, batch, self.scaler_state)
+        if self._offload.overlap:
+            self._collect_offload()
+            gnorm_prev = jnp.float32(self._offload._last_gnorm)
+            self._offload.step_async(grads, self.params, self.global_steps)
+            self._last_loss = loss
+            self._last_gnorm = gnorm_prev
+            self._commit_step(False)
+            return loss
         new_params, skipped = self._offload.step(grads, self.params,
                                                  self.global_steps)
         if not skipped:
@@ -687,12 +733,16 @@ class DeepSpeedTpuEngine:
                         client_state: Optional[Dict] = None, **kw) -> None:
         from deepspeed_tpu.runtime.checkpoint import save_checkpoint
 
+        if self._offload is not None and self._offload.overlap:
+            self._collect_offload()  # drain the async step before snapshotting
         save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True, **kw):
         from deepspeed_tpu.runtime.checkpoint import load_checkpoint
 
+        if self._offload is not None and self._offload.overlap:
+            self._collect_offload()
         out = load_checkpoint(self, load_dir, tag=tag,
                               load_optimizer_states=load_optimizer_states)
         self._refresh_hpz()  # secondary copy is derived state, not checkpointed

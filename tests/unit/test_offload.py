@@ -117,6 +117,49 @@ class TestOffloadEngine:
         swp = os.path.join(str(tmp_path), "opt_states")
         assert any(f.endswith(".swp") for f in os.listdir(swp))
 
+    def test_zenflow_overlap_converges(self, eight_devices):
+        """ZenFlow async overlap: host Adam of step N runs during step N+1's
+        fwd/bwd; with 1-step bounded staleness the run still converges and
+        checkpoint boundaries drain the in-flight step."""
+        cfg = self._config("cpu")
+        cfg["zero_optimization"]["zenflow"] = {"overlap_step": True}
+        model = TransformerLM(get_preset("tiny"))
+        eng, *_ = ds.initialize(model=model, config=cfg)
+        assert eng._offload.overlap
+        fixed = {"input_ids": np.random.default_rng(0).integers(
+            0, 256, (2 * eng.topology.dp_world_size, 16))}
+        losses = []
+        for _ in range(6):
+            loss = eng.forward(fixed)
+            eng.backward(loss)
+            eng.step()
+            # an async step is now in flight (collected at the next boundary)
+            assert eng._offload._pending is not None
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # checkpoint drains the pending step
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            eng.save_checkpoint(d)
+        assert eng._offload._pending is None
+
+    def test_zenflow_tracks_sync_offload(self, eight_devices):
+        """Staleness-1 trajectories track the synchronous offload run at small
+        lr (ZenFlow's convergence claim, scaled to the test budget)."""
+        runs = {}
+        for overlap in (False, True):
+            cfg = self._config("cpu")
+            if overlap:
+                cfg["zero_optimization"]["zenflow"] = {"overlap_step": True}
+            eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                    config=cfg)
+            runs[overlap] = self._train(eng, steps=6)
+        # bounded staleness shifts the trajectory by exactly one step: step
+        # N's forward runs before update N-1 is applied
+        np.testing.assert_allclose(runs[True][1], runs[True][0], rtol=1e-6)
+        np.testing.assert_allclose(runs[True][1:], runs[False][:-1], rtol=2e-2)
+
     def test_offload_matches_jit_adamw(self, eight_devices):
         """Host C++ AdamW must track the jitted optax path closely."""
         losses = {}
