@@ -50,6 +50,9 @@ class TransformerConfig:
     learned_pos: Optional[bool] = None
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
+    # HF-style rope_scaling dict ({"rope_type": "llama3"|"linear", ...});
+    # None = unscaled
+    rope_scaling: Optional[Dict[str, Any]] = None
     norm_eps: float = 1e-5
 
     dtype: str = "bfloat16"        # compute dtype
@@ -163,8 +166,29 @@ def _norm(x: jax.Array, w: Params, kind: str, eps: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, max_seq: int, theta: float) -> jax.Array:
+def rope_frequencies(head_dim: int, max_seq: int, theta: float,
+                     scaling: Optional[Dict[str, Any]] = None) -> jax.Array:
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        rt = scaling.get("rope_type", scaling.get("type", "linear"))
+        if rt == "linear":
+            inv = inv / float(scaling["factor"])
+        elif rt == "llama3":
+            # HF Llama-3.1 frequency-band scaling: low-frequency bands divide
+            # by `factor`, high-frequency bands pass through, bands between
+            # interpolate smoothly (transformers modeling_rope_utils).
+            factor = float(scaling["factor"])
+            lo = float(scaling.get("low_freq_factor", 1.0))
+            hi = float(scaling.get("high_freq_factor", 4.0))
+            orig = float(scaling.get("original_max_position_embeddings", 8192))
+            wavelen = 2.0 * math.pi / inv
+            smooth = (orig / wavelen - lo) / (hi - lo)
+            interp = (1 - smooth) * inv / factor + smooth * inv
+            inv = jnp.where(wavelen > orig / lo, inv / factor,
+                            jnp.where(wavelen < orig / hi, inv, interp))
+        else:
+            raise ValueError(f"unsupported rope_scaling type '{rt}' "
+                             "(have: linear, llama3)")
     t = jnp.arange(max_seq, dtype=jnp.float32)
     return jnp.outer(t, inv)  # [max_seq, head_dim//2]
 
@@ -305,7 +329,8 @@ class TransformerLM:
     def __init__(self, cfg: TransformerConfig, moe_fn: Optional[Callable] = None):
         self.cfg = cfg
         self.moe_fn = moe_fn
-        self._freqs = (rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        self._freqs = (rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                        cfg.rope_theta, cfg.rope_scaling)
                        if cfg.use_rope else None)
 
     # ---- init -------------------------------------------------------------

@@ -1,0 +1,173 @@
+"""HF interop tests — the analog of the reference's AutoTP/checkpoint-loading
+unit tests: a tiny HF Llama checkpoint must import with exact logits parity,
+Mixtral must import structurally, and AutoTP spec inference must reproduce the
+row/col policy on both naming families."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_ckpt(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      rms_norm_eps=1e-5, tie_word_embeddings=True)
+    model = LlamaForCausalLM(cfg)
+    d = str(tmp_path_factory.mktemp("hf_llama"))
+    model.save_pretrained(d)
+    return d, model
+
+
+def test_llama_import_logits_parity(tiny_llama_ckpt):
+    """Imported weights + our forward == HF forward (fp32, atol 1e-4)."""
+    import torch
+
+    from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+    path, hf_model = tiny_llama_ckpt
+    model, params = load_hf_checkpoint(path, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 256, (2, 16))
+    ours = np.asarray(jax.jit(model.logits)(params, ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-3, rtol=1e-3)
+
+
+def test_llama_import_trains_under_engine(tiny_llama_ckpt, eight_devices):
+    """An imported checkpoint plugs straight into ds.initialize (the reference
+    user journey: HF model -> deepspeed engine)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+    path, _ = tiny_llama_ckpt
+    model, params = load_hf_checkpoint(path)
+    eng, *_ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+        "mesh": {"fsdp": 4, "tp": 2},
+        "steps_per_print": 100})
+    eng.params = jax.device_put(params, eng.param_sharding)
+    batch = {"input_ids": np.random.default_rng(1).integers(0, 256, (8, 16))}
+    losses = []
+    for _ in range(3):
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_llama3_rope_scaling_parity(tmp_path):
+    """Llama-3.1-style rope_scaling must reproduce transformers' frequency
+    banding — unscaled frequencies would silently diverge at all positions."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+    torch.manual_seed(1)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64,
+                      rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                                    "low_freq_factor": 1.0,
+                                    "high_freq_factor": 4.0,
+                                    "original_max_position_embeddings": 32},
+                      tie_word_embeddings=False)
+    hf_model = LlamaForCausalLM(cfg)
+    hf_model.save_pretrained(str(tmp_path))
+    model, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+    assert model.cfg.rope_scaling["rope_type"] == "llama3"
+    ids = np.random.default_rng(2).integers(0, 128, (1, 48))
+    ours = np.asarray(jax.jit(model.logits)(params, ids))
+    with torch.no_grad():
+        import torch as t
+
+        theirs = hf_model(t.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-3, rtol=1e-3)
+
+
+def test_mixtral_import(tmp_path):
+    """Mixtral (MoE) imports into the EP layout; forward is finite. Routing is
+    GShard expert-choice here vs Mixtral token-choice, so logits parity is not
+    asserted (documented in models/hf.py)."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+    torch.manual_seed(0)
+    cfg = MixtralConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, num_local_experts=4,
+                        num_experts_per_tok=2, max_position_embeddings=32)
+    MixtralForCausalLM(cfg).save_pretrained(str(tmp_path))
+    model, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+    assert model.cfg.num_experts == 4 and model.cfg.top_k == 2
+    assert params["layers"]["mlp"]["w_gate"].shape == (2, 4, 32, 64)
+    assert params["layers"]["mlp"]["router"].shape == (2, 32, 4)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 8))
+    logits = np.asarray(jax.jit(model.logits)(params, ids))
+    assert np.isfinite(logits).all()
+
+
+@pytest.mark.parametrize("preset", ["tiny", "tiny-moe"])
+def test_infer_tp_specs_matches_hand_policy(preset):
+    """Name-pattern inference reproduces the family's hand-written megatron
+    policy on the WHOLE tree — dense and stacked-MoE (ep on the expert dim)."""
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.models.hf import infer_tp_specs
+
+    model = TransformerLM(get_preset(preset))
+    params = jax.eval_shape(model.init, jax.random.key(0))
+    specs = infer_tp_specs(params)
+    hand = model.param_specs()
+
+    def norm(tree):
+        # compare per-dim entries, padding trailing Nones
+        flat = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: x is None or isinstance(x, P))[0]
+        return {tuple(str(k) for k in kp): tuple(s or P()) + (None,) * 4
+                for kp, s in flat}
+
+    got, want = norm(specs), norm(hand)
+    for key in want:
+        assert got[key][:4] == want[key][:4], (key, got[key], want[key])
+    if preset == "tiny-moe":
+        assert specs["layers"]["mlp"]["w_gate"] == P(None, "ep", None, "tp")
+        assert specs["layers"]["mlp"]["w_down"] == P(None, "ep", "tp", None)
+
+
+def test_infer_tp_specs_hf_naming():
+    from deepspeed_tpu.models.hf import infer_tp_specs
+
+    tree = {
+        "model.layers.0.self_attn.q_proj.weight": np.zeros((64, 32)),
+        "model.layers.0.self_attn.o_proj.weight": np.zeros((32, 64)),
+        "model.layers.0.mlp.down_proj.weight": np.zeros((32, 128)),
+        "model.embed_tokens.weight": np.zeros((256, 32)),
+        "model.layers.0.block_sparse_moe.experts.1.w1.weight": np.zeros((128, 32)),
+        "model.norm.weight": np.zeros((32,)),
+    }
+    specs = infer_tp_specs(tree)
+    # torch [out, in]: col-parallel shards out (dim -2), row-parallel in (dim -1)
+    assert specs["model.layers.0.self_attn.q_proj.weight"] == P("tp", None)
+    assert specs["model.layers.0.self_attn.o_proj.weight"] == P(None, "tp")
+    assert specs["model.layers.0.mlp.down_proj.weight"] == P(None, "tp")
+    assert specs["model.embed_tokens.weight"] == P("tp", None)
+    # raw HF expert leaf is 2-D (expert axis = python structure): plain col
+    assert specs["model.layers.0.block_sparse_moe.experts.1.w1.weight"] == \
+        P("tp", None)
+    assert specs["model.norm.weight"] == P(None)
