@@ -274,6 +274,21 @@ class PipelineConfig(DSTpuConfigModel):
     pipe_schedule: str = "1f1b"  # 1f1b|gpipe
 
 
+class ElasticityConfig(DSTpuConfigModel):
+    """``elasticity`` section (reference ``deepspeed/elasticity/config.py``):
+    pick a global batch compatible with many chip counts so training survives
+    world-size changes with the batch held constant."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2048
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 8])
+    min_gpus: int = 1
+    max_gpus: int = 1024
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
 class DeepSpeedTpuConfig(DSTpuConfigModel):
     """The root config. Accepts a dict or a JSON file path via :func:`from_config`."""
 
@@ -299,6 +314,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
     moe: MoEConfig = Field(default_factory=MoEConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
 
     gradient_clipping: float = 0.0
     steps_per_print: int = 10

@@ -1,11 +1,15 @@
-"""Elastic training: batch-compatible world sizes + resume math.
+"""Elastic training: batch-compatible world sizes + the monitor/restart agent.
 
 Parity target: ``deepspeed/elasticity/elasticity.py`` — ``compute_elastic_config``
-(:233) and the v0.1/v0.2 candidate-batch algorithms (:83/:126). The agent/rendezvous
-half (``DSElasticAgent``) maps to the pod scheduler restarting hosts + checkpoint
-resume; the portable part is exactly this math.
+(:233) and the v0.1/v0.2 candidate-batch algorithms (:83/:126) — plus
+``elastic_agent.py:32`` (``DSElasticAgent``): the cohort monitor that
+re-rendezvouses at a smaller world size on failure, resuming from the latest
+(reshardable) checkpoint with the global batch held constant.
 """
 
+from deepspeed_tpu.elasticity.agent import (  # noqa: F401
+    AgentResult, ElasticAgent, subprocess_spawn,
+)
 from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
     compute_elastic_config, get_compatible_chip_counts,
 )

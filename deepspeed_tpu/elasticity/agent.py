@@ -1,0 +1,129 @@
+"""Elastic agent: monitor the training cohort, restart on failure at a
+compatible (usually smaller) world size, resuming from the latest checkpoint
+with the global batch held constant.
+
+Parity target: ``deepspeed/elasticity/elastic_agent.py:32``
+(``DSElasticAgent._invoke_run`` — monitor workers, on failure re-rendezvous
+with whatever is healthy) + ``launcher/launch.py:276`` (the per-rank monitor
+loop and cohort kill). TPU-native shape: the unit of failure is a HOST (its
+chips vanish with it), and a JAX restart re-forms the mesh from the surviving
+hosts, so the agent collapses to: spawn cohort → wait → on nonzero exit pick
+the next admissible chip count from the elastic config → respawn. State
+continuity is the engine's reshard-on-load checkpoint (universal checkpoint),
+which restores a stage-3/dp=N checkpoint at any other admissible layout.
+
+The agent is transport-agnostic: ``spawn(chips, micro_batch, restart_idx)``
+returns an exit code — the launcher provides subprocess-based spawns; tests
+inject failures deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class Incarnation:
+    """One cohort lifetime."""
+
+    chips: int
+    micro_batch: int
+    global_batch: int
+    exit_code: int
+    duration_s: float
+
+
+@dataclasses.dataclass
+class AgentResult:
+    succeeded: bool
+    history: List[Incarnation]
+
+    @property
+    def restarts(self) -> int:
+        return max(0, len(self.history) - 1)
+
+
+class ElasticAgent:
+    """Run-until-success (or budget exhausted) over world-size changes.
+
+    ``elastic_config``: the reference schema dict/pydantic dump —
+    max_train_batch_size, micro_batch_sizes, min_gpus, max_gpus,
+    prefer_larger_batch. The chosen global batch is identical for every
+    admissible chip count; only micro-batch / grad-accum shift.
+    """
+
+    def __init__(self, elastic_config: Dict, max_restarts: int = 3):
+        self.cfg = dict(elastic_config)
+        self.max_restarts = max_restarts
+        self.global_batch, self.valid_chips, self.micro_map = \
+            compute_elastic_config(self.cfg)
+
+    def next_world_size(self, current: int, lost: int = 1) -> Optional[int]:
+        """Largest admissible chip count after losing ``lost`` chips
+        (the re-rendezvous decision of elastic_agent.py:200)."""
+        candidates = [c for c in self.valid_chips if c <= current - lost]
+        return max(candidates) if candidates else None
+
+    def run(self, spawn: Callable[[int, int, int], int], chips: int,
+            lost_per_failure: int = 1) -> AgentResult:
+        """Drive cohorts until one exits 0.
+
+        ``spawn(chips, micro_batch, restart_idx) -> exit_code`` blocks for the
+        cohort lifetime (the launcher's wait-on-procs). A nonzero exit is
+        treated as a host loss of ``lost_per_failure`` chips.
+        """
+        if chips not in self.micro_map:
+            raise ValueError(f"initial world size {chips} is not "
+                             f"elastic-compatible (valid: {self.valid_chips})")
+        history: List[Incarnation] = []
+        for attempt in range(self.max_restarts + 1):
+            micro = self.micro_map[chips]
+            log_dist(f"elastic agent: incarnation {attempt} chips={chips} "
+                     f"micro={micro} global_batch={self.global_batch}")
+            t0 = time.time()
+            rc = spawn(chips, micro, attempt)
+            history.append(Incarnation(chips, micro, self.global_batch, rc,
+                                       time.time() - t0))
+            if rc == 0:
+                return AgentResult(True, history)
+            if attempt == self.max_restarts:
+                logger.error(f"elastic agent: cohort failed (rc={rc}) and the "
+                             f"restart budget ({self.max_restarts}) is spent")
+                break
+            nxt = self.next_world_size(chips, lost_per_failure)
+            if nxt is None:
+                logger.error("elastic agent: no admissible world size below "
+                             f"{chips}; giving up")
+                return AgentResult(False, history)
+            logger.warning(f"elastic agent: cohort failed (rc={rc}); "
+                           f"restarting at {nxt} chips (was {chips})")
+            chips = nxt
+        return AgentResult(False, history)
+
+
+def subprocess_spawn(script: str, script_args: List[str], base_env: Dict[str, str],
+                     checkpoint_dir: str) -> Callable[[int, int, int], int]:
+    """The launcher-facing spawn: one local process per cohort, world size and
+    elastic batch handed over via env (the trainer reads DSTPU_ELASTIC_*).
+    Multi-host cohorts reuse the ssh fan-out of ``launcher/runner.py`` with a
+    host subset of the right size."""
+    import subprocess
+    import sys
+
+    def spawn(chips: int, micro_batch: int, restart_idx: int) -> int:
+        env = dict(base_env)
+        env.update({
+            "DSTPU_ELASTIC_CHIPS": str(chips),
+            "DSTPU_ELASTIC_MICRO": str(micro_batch),
+            "DSTPU_RESTART_COUNT": str(restart_idx),
+            "DSTPU_CHECKPOINT_DIR": checkpoint_dir,
+        })
+        return subprocess.call([sys.executable, script] + list(script_args),
+                               env=env)
+
+    return spawn

@@ -51,8 +51,10 @@ def compute_elastic_config(elastic_config: Dict, target_chips: Optional[int] = N
     best: Tuple[int, List[int]] = (0, [])
     for batch in _candidate_batches(max_batch, micro_batches):
         chips = get_compatible_chip_counts(batch, micro_batches, min_chips, max_chips)
+        # candidates iterate descending: on compatibility ties, prefer_larger
+        # keeps the first (largest) batch, otherwise the last (smallest) wins
         if len(chips) > len(best[1]) or (
-                len(chips) == len(best[1]) and prefer_larger and batch > best[0]):
+                len(chips) == len(best[1]) and chips and not prefer_larger):
             best = (batch, chips)
     batch, chips = best
     if not chips:

@@ -162,11 +162,17 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             tree, shardings)
 
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(engine.mesh, PartitionSpec())
     target = {
         "params": abstract(engine.params, engine.param_sharding),
         "opt_state": abstract(engine.opt_state, engine.opt_sharding),
+        # explicit replicated sharding: restoring on a DIFFERENT device count
+        # cannot reuse the sharding recorded in the file (elastic resume)
         "scaler": jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), engine.scaler_state),
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=repl),
+            engine.scaler_state),
     }
     ckptr = ocp.StandardCheckpointer()
     state = ckptr.restore(os.path.join(path, "state"), target)

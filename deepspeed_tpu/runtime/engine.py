@@ -55,6 +55,8 @@ class DeepSpeedTpuEngine:
         self.config = config
         self.topology = topology or build_mesh(config.mesh)
         self.mesh = self.topology.mesh
+        if config.elasticity.enabled:
+            self._apply_elastic_batch(config)
         config.resolve_batch_sizes(self.topology.dp_world_size)
 
         from deepspeed_tpu.runtime.pipe import maybe_wrap_pipeline
@@ -183,6 +185,43 @@ class DeepSpeedTpuEngine:
                  f"zero_stage={self.zero_stage}, mesh={self.topology}, "
                  f"batch={config.train_batch_size} (micro={config.train_micro_batch_size_per_gpu}"
                  f" x ga={config.gradient_accumulation_steps} x dp={self.topology.dp_world_size})")
+
+    def _apply_elastic_batch(self, config) -> None:
+        """Elasticity: derive (batch, micro, ga) from the elastic config for
+        THIS world size — the global batch stays constant across every
+        admissible chip count (reference elasticity/config.py contract)."""
+        from deepspeed_tpu.elasticity import compute_elastic_config
+
+        ecfg = config.elasticity
+        explicit = [k for k, v in (
+            ("train_batch_size", config.train_batch_size),
+            ("train_micro_batch_size_per_gpu",
+             config.train_micro_batch_size_per_gpu),
+            ("gradient_accumulation_steps", config.gradient_accumulation_steps),
+        ) if v not in (None, "auto")]
+        if explicit and not ecfg.ignore_non_elastic_batch_info:
+            raise ValueError(
+                f"elasticity.enabled with explicit {explicit}: set "
+                "ignore_non_elastic_batch_info=true to let the elastic config "
+                "own the batch triple (reference raises the same)")
+        dp = self.topology.dp_world_size
+        batch, _valid, micro_map = compute_elastic_config(
+            ecfg.model_dump(), target_chips=dp)
+        micro = micro_map[dp]
+        # the elastic agent ships its decision via env; a drift between the
+        # agent's elastic config and the trainer's would silently void the
+        # constant-global-batch guarantee — verify instead of trusting
+        agent_micro = os.environ.get("DSTPU_ELASTIC_MICRO")
+        if agent_micro is not None and int(agent_micro) != micro:
+            raise ValueError(
+                f"elastic agent chose micro_batch={agent_micro} but this "
+                f"trainer's elasticity config derives {micro} at dp={dp} — "
+                "agent and trainer elastic configs have drifted")
+        config.train_batch_size = batch
+        config.train_micro_batch_size_per_gpu = micro
+        config.gradient_accumulation_steps = batch // (micro * dp)
+        log_dist(f"elastic batch: global={batch} micro={micro} "
+                 f"ga={config.gradient_accumulation_steps} at dp={dp}")
 
     # ------------------------------------------------------------------
     # compiled-function construction

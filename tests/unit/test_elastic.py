@@ -1,0 +1,149 @@
+"""Elastic agent tests — the analog of the reference's elasticity unit tests
+plus the elastic_agent restart semantics: a simulated host loss must resume at
+a smaller chip count from the latest checkpoint with the global batch constant."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.elasticity import ElasticAgent, compute_elastic_config
+from deepspeed_tpu.models import TransformerLM, get_preset
+
+ECFG = {"max_train_batch_size": 32, "micro_batch_sizes": [1, 2, 4],
+        "min_gpus": 1, "max_gpus": 8, "prefer_larger_batch": True}
+
+
+def test_agent_restart_sequence():
+    """Failures walk down admissible world sizes; batch constant, micro adapts."""
+    agent = ElasticAgent(ECFG, max_restarts=3)
+    calls = []
+
+    def spawn(chips, micro, idx):
+        calls.append((chips, micro, idx))
+        return 0 if len(calls) >= 3 else 1  # two failures, then success
+
+    res = agent.run(spawn, chips=8, lost_per_failure=1)
+    assert res.succeeded and res.restarts == 2
+    worlds = [h.chips for h in res.history]
+    assert worlds[0] == 8 and worlds == sorted(worlds, reverse=True)
+    assert len({h.global_batch for h in res.history}) == 1
+    # micro * some_ga * chips == global batch at every incarnation
+    for h in res.history:
+        assert h.global_batch % (h.chips * h.micro_batch) == 0
+
+
+def test_prefer_smaller_batch_tiebreak():
+    """prefer_larger_batch=False picks the smallest batch among equally
+    compatible candidates (was a silent no-op)."""
+    # 48 and 24 tie at 6 compatible counts ({1,2,3,4,6,8}) with micro=[1]
+    cfg = {"max_train_batch_size": 48, "micro_batch_sizes": [1],
+           "min_gpus": 1, "max_gpus": 8}
+    big, chips_b, _ = compute_elastic_config({**cfg, "prefer_larger_batch": True})
+    small, chips_s, _ = compute_elastic_config({**cfg, "prefer_larger_batch": False})
+    assert len(chips_b) == len(chips_s)
+    assert small < big
+
+
+def test_agent_gives_up_below_min():
+    agent = ElasticAgent({**ECFG, "min_gpus": 7}, max_restarts=5)
+    res = agent.run(lambda c, m, i: 1, chips=8)
+    assert not res.succeeded
+    assert res.history[-1].chips == 8  # nothing admissible below → stop
+
+
+def test_elastic_engine_batch_resolution(eight_devices):
+    """elasticity.enabled drives the batch triple from the world size."""
+    eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "elasticity": {"enabled": True, **ECFG},
+        "mesh": {"dp": 8}, "steps_per_print": 100})
+    batch, _, micro_map = compute_elastic_config(ECFG, target_chips=8)
+    assert eng.train_batch_size() == batch
+    assert eng.train_micro_batch_size_per_gpu() == micro_map[8]
+
+    with pytest.raises(ValueError, match="ignore_non_elastic_batch_info"):
+        ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+            "train_batch_size": 64,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "elasticity": {"enabled": True, **ECFG},
+            "mesh": {"dp": 8}})
+
+
+TRAINER = textwrap.dedent("""
+    import json, os, sys
+    chips = int(os.environ["DSTPU_ELASTIC_CHIPS"])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={chips}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    ckpt = os.environ["DSTPU_CHECKPOINT_DIR"]
+    restart = int(os.environ["DSTPU_RESTART_COUNT"])
+    eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "elasticity": {"enabled": True, "max_train_batch_size": 32,
+                       "micro_batch_sizes": [1, 2, 4],
+                       "min_gpus": 1, "max_gpus": 8},
+        "mesh": {"fsdp": chips}, "steps_per_print": 100})
+    if os.path.exists(os.path.join(ckpt, "latest")):
+        eng.load_checkpoint(ckpt)
+    rec = {"chips": chips, "global_batch": eng.train_batch_size(),
+           "micro": eng.train_micro_batch_size_per_gpu(),
+           "start_step": eng.global_steps}
+    rng = np.random.default_rng(0)
+    B = eng.train_micro_batch_size_per_gpu() * eng.topology.dp_world_size
+    while eng.global_steps < 6:
+        for _ in range(eng.gradient_accumulation_steps()):
+            loss = eng.forward({"input_ids": rng.integers(0, 256, (B, 16))})
+            eng.backward(loss)
+        eng.step()
+        eng.save_checkpoint(ckpt)
+        if restart == 0 and eng.global_steps >= 3:
+            os._exit(13)  # simulated host loss mid-run
+    rec["end_step"] = eng.global_steps
+    rec["loss"] = float(loss)
+    json.dump(rec, open(os.path.join(ckpt, f"run{restart}.json"), "w"))
+""")
+
+
+def test_host_loss_resumes_smaller_world(tmp_path):
+    """End-to-end: cohort dies at step 3 (rc=13) on 8 chips; the agent restarts
+    at the next admissible world size; training resumes from the step-3
+    checkpoint (ZeRO-2 reshard-on-load) and finishes at step 6 with the SAME
+    global batch."""
+    from deepspeed_tpu.elasticity import subprocess_spawn
+
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER)
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+        + os.pathsep + env.get("PYTHONPATH", ""))
+    agent = ElasticAgent(ECFG, max_restarts=2)
+    res = agent.run(subprocess_spawn(str(script), [], env, ckpt), chips=8,
+                    lost_per_failure=4)  # lose half the pod
+    assert res.succeeded, [h.exit_code for h in res.history]
+    assert res.restarts == 1
+    assert res.history[0].exit_code == 13 and res.history[1].exit_code == 0
+    assert res.history[0].chips == 8 and res.history[1].chips == 4
+    rec = json.load(open(os.path.join(ckpt, "run1.json")))
+    assert rec["chips"] == 4
+    assert rec["start_step"] == 3, "did not resume from the step-3 checkpoint"
+    assert rec["end_step"] == 6
+    # the elastic guarantee: same global batch at both world sizes
+    assert rec["global_batch"] == res.history[0].global_batch
