@@ -274,6 +274,53 @@ class PipelineConfig(DSTpuConfigModel):
     pipe_schedule: str = "1f1b"  # 1f1b|gpipe
 
 
+class CurriculumLearningConfig(DSTpuConfigModel):
+    """``data_efficiency.data_sampling.curriculum_learning`` (reference
+    ``runtime/data_pipeline/config.py``)."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataSamplingConfig(DSTpuConfigModel):
+    enabled: bool = False
+    curriculum_learning: CurriculumLearningConfig = Field(
+        default_factory=CurriculumLearningConfig)
+
+
+class RandomLTDConfig(DSTpuConfigModel):
+    """``data_efficiency.data_routing.random_ltd``: random layerwise token
+    dropping — middle layers process a growing random subset of tokens."""
+
+    enabled: bool = False
+    # layers [start, end) run on the token subset (first/last stay dense)
+    random_ltd_layer_start: int = 1
+    random_ltd_layer_end: int = -1          # -1 = num_layers - 1
+    # kept-token schedule: from min_value, +step_size every interval steps,
+    # clamped at max_value (0 = the model's max_seq_len)
+    min_value: int = 128
+    max_value: int = 0
+    step_size: int = 16
+    interval: int = 100
+
+
+class DataRoutingConfig(DSTpuConfigModel):
+    enabled: bool = False
+    random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
+
+
+class DataEfficiencyConfig(DSTpuConfigModel):
+    """``data_efficiency`` section (reference data_pipeline/config.py)."""
+
+    enabled: bool = False
+    data_sampling: DataSamplingConfig = Field(default_factory=DataSamplingConfig)
+    data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
+
+
 class ElasticityConfig(DSTpuConfigModel):
     """``elasticity`` section (reference ``deepspeed/elasticity/config.py``):
     pick a global batch compatible with many chip counts so training survives
@@ -315,6 +362,8 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     moe: MoEConfig = Field(default_factory=MoEConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    data_efficiency: DataEfficiencyConfig = Field(
+        default_factory=DataEfficiencyConfig)
 
     gradient_clipping: float = 0.0
     steps_per_print: int = 10

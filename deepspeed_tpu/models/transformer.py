@@ -275,12 +275,15 @@ def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
 
 def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                       freqs: Optional[jax.Array], attn_fn: Callable,
-                      moe_fn: Optional[Callable] = None) -> Any:
-    """One pre-norm decoder block. Returns (x, aux_loss)."""
+                      moe_fn: Optional[Callable] = None,
+                      positions: Optional[jax.Array] = None) -> Any:
+    """One pre-norm decoder block. Returns (x, aux_loss). ``positions`` [B, T]
+    overrides RoPE positions (random-LTD token subsets)."""
     dt = jnp.dtype(cfg.dtype)
     wc = jax.tree_util.tree_map(lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, w)
     attn_out, _ = attention_block(_norm(x, wc["ln1"], cfg.norm, cfg.norm_eps),
-                                  wc["attn"], cfg, freqs, attn_fn)
+                                  wc["attn"], cfg, freqs, attn_fn,
+                                  positions=positions)
     x = x + attn_out
     h = _norm(x, wc["ln2"], cfg.norm, cfg.norm_eps)
     if moe_fn is not None:
@@ -332,6 +335,20 @@ class TransformerLM:
         self._freqs = (rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                         cfg.rope_theta, cfg.rope_scaling)
                        if cfg.use_rope else None)
+        # random-LTD (data_routing/basic_layer.py parity): when set, layers in
+        # [start, end) process only `keep` randomly chosen tokens per step;
+        # dropped tokens ride the residual stream untouched. The engine owns
+        # the keep schedule and rebuilds its jits when the bucket changes.
+        self._ltd_keep: Optional[int] = None
+        self._ltd_layers: Optional[tuple] = None
+
+    def set_random_ltd(self, keep: Optional[int],
+                       layers: Optional[tuple] = None) -> None:
+        L = self.cfg.num_layers
+        self._ltd_keep = keep
+        if keep is not None:
+            start, end = layers if layers is not None else (1, L - 1)
+            self._ltd_layers = (max(0, start), end if end > 0 else L - 1)
 
     # ---- init -------------------------------------------------------------
     def init(self, rng: jax.Array) -> Params:
@@ -389,7 +406,8 @@ class TransformerLM:
 
     # ---- forward ----------------------------------------------------------
     def logits(self, params: Params, input_ids: jax.Array,
-               positions: Optional[jax.Array] = None) -> jax.Array:
+               positions: Optional[jax.Array] = None,
+               ltd_seed: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         x = params["embed"]["tokens"].astype(dt)[input_ids]
@@ -410,20 +428,57 @@ class TransformerLM:
             lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
             params["layers"])
 
-        def body(carry, layer_w):
-            y, aux = transformer_block(carry, layer_w, cfg, freqs, attn_fn,
-                                       self.moe_fn)
-            return y, aux
+        T = input_ids.shape[1]
+        ltd_keep = self._ltd_keep
+        ltd = ltd_keep is not None and ltd_keep < T
+        if ltd:
+            # random layerwise token dropping: per-LTD-layer random sorted
+            # token subset; the subset runs the block (causal order and RoPE
+            # positions preserved), dropped tokens skip via the residual.
+            # Key = step seed (engine-provided, fresh per step/epoch) folded
+            # with batch content (fresh per microbatch).
+            start_l, end_l = self._ltd_layers
+            seed = jnp.uint32(0) if ltd_seed is None else ltd_seed
+            key0 = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                      jnp.sum(input_ids).astype(jnp.uint32))
+
+            def ltd_block(h, layer_w, li):
+                key = jax.random.fold_in(key0, li)
+                pos = jnp.sort(jax.random.permutation(key, T)[:ltd_keep])
+                h_sub = h[:, pos]
+                posb = jnp.broadcast_to(pos[None], (h.shape[0], ltd_keep))
+                y, aux = transformer_block(h_sub, layer_w, cfg, freqs, attn_fn,
+                                           self.moe_fn, positions=posb)
+                return h.at[:, pos].set(y), aux
+
+            def body(carry, xs):
+                layer_w, li = xs
+                is_ltd = jnp.logical_and(li >= start_l, li < end_l)
+                return jax.lax.cond(
+                    is_ltd,
+                    lambda c, w, i: ltd_block(c, w, i),
+                    lambda c, w, i: transformer_block(c, w, cfg, freqs,
+                                                      attn_fn, self.moe_fn),
+                    carry, layer_w, li)
+
+            xs = (layers, jnp.arange(cfg.num_layers))
+        else:
+            def body(carry, xs):
+                y, aux = transformer_block(carry, xs, cfg, freqs, attn_fn,
+                                           self.moe_fn)
+                return y, aux
+
+            xs = layers
 
         body = _maybe_remat(body, cfg.remat_policy)
         if cfg.scan_layers:
-            x, auxes = jax.lax.scan(body, x, layers)
+            x, auxes = jax.lax.scan(body, x, xs)
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                layer_w = jax.tree_util.tree_map(lambda p: p[i], layers)
-                x, aux = body(x, layer_w)
+                xi = jax.tree_util.tree_map(lambda p: p[i], layers)
+                x, aux = body(x, (xi, jnp.int32(i)) if ltd else xi)
                 aux_total = aux_total + aux
         x = _norm(x, {k: v for k, v in params["final_norm"].items()}, cfg.norm,
                   cfg.norm_eps)
@@ -436,7 +491,9 @@ class TransformerLM:
     def loss_fn(self, params: Params, batch: Dict[str, jax.Array],
                 rng: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
-        logits = self.logits(params, batch["input_ids"])
+        seed = batch.get("ltd_seed")
+        logits = self.logits(params, batch["input_ids"],
+                             ltd_seed=None if seed is None else seed[0])
         loss = lm_loss(cfg, logits, batch)
         aux = getattr(self, "_last_aux_loss", None)
         if aux is not None and cfg.num_experts > 1:

@@ -49,11 +49,3 @@ class CurriculumScheduler:
 
     def get_current_difficulty(self) -> int:
         return self.current_difficulty
-
-    def truncate_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
-        """Apply seqlen curriculum: truncate sequence dims to current difficulty."""
-        if self.schedule_type != "seqlen":
-            return batch
-        d = self.current_difficulty
-        return {k: (v[:, :d] if getattr(v, "ndim", 0) >= 2 else v)
-                for k, v in batch.items()}

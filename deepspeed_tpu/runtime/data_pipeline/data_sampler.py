@@ -1,0 +1,56 @@
+"""Curriculum-aware data sampler.
+
+Parity target: ``deepspeed/runtime/data_pipeline/data_sampling/
+data_sampler.py:36`` ``DeepSpeedDataSampler`` — at each step, draw only
+samples whose difficulty metric (seqlen, perplexity bucket, ...) is within the
+curriculum's current ceiling, so the data order itself follows the schedule
+(not just a truncation of whatever was drawn).
+
+Design: the sampler keeps indices sorted into difficulty buckets; each batch
+draws uniformly from the union of admissible buckets under the current
+difficulty, reshuffling within the admissible pool per epoch. Deterministic
+given (seed, epoch, step) — every data-parallel process computes the same
+order (the engine's loader contract).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
+
+
+class DataEfficiencySampler:
+    """Yields index batches filtered by the curriculum difficulty."""
+
+    def __init__(self, difficulties: Sequence[float], batch_size: int,
+                 scheduler: CurriculumScheduler, seed: int = 42,
+                 drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        self.batch_size = int(batch_size)
+        self.scheduler = scheduler
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        # ascending difficulty order; prefix of this array = admissible pool
+        self._order = np.argsort(self.difficulties, kind="stable")
+        self._sorted_diff = self.difficulties[self._order]
+
+    def set_step(self, global_step: int) -> None:
+        self.global_step = int(global_step)
+
+    def _admissible(self) -> np.ndarray:
+        limit = self.scheduler.update_difficulty(self.global_step)
+        n = int(np.searchsorted(self._sorted_diff, limit, side="right"))
+        return self._order[:max(n, self.batch_size)]  # never starve a batch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed + self.global_step)
+        while True:
+            pool = self._admissible()
+            idx = rng.choice(pool, size=self.batch_size,
+                             replace=len(pool) < self.batch_size)
+            yield idx
+            self.global_step += 1

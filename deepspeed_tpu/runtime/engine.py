@@ -177,6 +177,24 @@ class DeepSpeedTpuEngine:
 
             self.monitor = MonitorMaster(config.monitor_config)
 
+        # ---- data efficiency (curriculum sampling/truncation + random-LTD) --
+        de = config.data_efficiency
+        self._curriculum = None
+        self._ltd_cfg = None
+        if de.enabled and de.data_sampling.enabled \
+                and de.data_sampling.curriculum_learning.enabled:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self._curriculum = CurriculumScheduler(
+                de.data_sampling.curriculum_learning.model_dump())
+        if de.enabled and de.data_routing.enabled \
+                and de.data_routing.random_ltd.enabled:
+            self._ltd_cfg = de.data_routing.random_ltd
+            if not hasattr(self.module, "set_random_ltd"):
+                raise ValueError("random_ltd requires a model with "
+                                 "set_random_ltd (TransformerLM family)")
+            self._update_random_ltd()
+
         self.training_dataloader = None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data,
@@ -399,6 +417,55 @@ class DeepSpeedTpuEngine:
         return DeepSpeedTpuDataLoader(dataset, gbs, collate_fn=collate_fn,
                                       seed=self.config.seed, **kw)
 
+    def _update_random_ltd(self) -> None:
+        """Advance the random-LTD kept-token schedule (data_routing parity):
+        keep grows from min_value by step_size every interval steps, clamped at
+        max_value — once at the ceiling the bucket never changes again. A
+        bucket change rebuilds the jitted programs (one recompile per
+        bucket)."""
+        c = self._ltd_cfg
+        ceil = c.max_value or getattr(self.module.cfg, "max_seq_len", 1 << 30)
+        keep = min(ceil, c.min_value
+                   + c.step_size * (self.global_steps // max(c.interval, 1)))
+        if keep != self.module._ltd_keep:
+            self.module.set_random_ltd(
+                keep, (c.random_ltd_layer_start, c.random_ltd_layer_end))
+            if hasattr(self, "_fused_step_cache"):
+                self._fused_step_cache.clear()
+                self._build_jit_fns()
+                self._refresh_hpz()  # _build_jit_fns resets the hpZ secondary
+
+    def curriculum_difficulty(self) -> Optional[int]:
+        if self._curriculum is None:
+            return None
+        return self._curriculum.update_difficulty(self.global_steps)
+
+    def _apply_curriculum(self, batch):
+        """Truncate sequence keys to the curriculum difficulty (the engine-side
+        half of DeepSpeedDataSampler: shapes bucket by difficulty_step, so
+        recompiles are bounded by the schedule's granularity)."""
+        if self._curriculum is None or not isinstance(batch, dict):
+            return batch
+        diff = self.curriculum_difficulty()
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.ndim >= 2 and arr.shape[1] > diff and k in (
+                    "input_ids", "labels", "attention_mask", "position_ids"):
+                arr = arr[:, :diff]
+            out[k] = arr
+        return out
+
+    def _inject_ltd_seed(self, batch):
+        """Fresh per-step randomness for random-LTD token subsets: the step
+        counter rides the batch (broadcast per example so the fused GA reshape
+        works) and the model folds it with the content hash."""
+        if self._ltd_cfg is None or not isinstance(batch, dict):
+            return batch
+        b = np.asarray(batch["input_ids"]).shape[0]
+        return {**batch, "ltd_seed": np.full((b,), self.global_steps
+                                             + self.micro_steps, np.int32)}
+
     def _put_batch(self, batch):
         """Host batch → device arrays laid out over (dp, fsdp) × sp."""
         bspec = shd.batch_spec(self.topology)
@@ -416,6 +483,10 @@ class DeepSpeedTpuEngine:
     def forward(self, batch, *args, **kwargs):
         """Compute micro-batch loss (and, functionally, its grads) — engine.py:2675."""
         self.tput_timer.start()
+        if self._ltd_cfg is not None and self._grad_acc_count == 0:
+            self._update_random_ltd()  # only at accumulation boundaries
+        batch = self._apply_curriculum(batch)
+        batch = self._inject_ltd_seed(batch)
         batch = self._put_batch(batch)
         p_in = (self._hpz_secondary
                 if self._zpp is not None and self._zpp.uses_secondary
@@ -599,6 +670,10 @@ class DeepSpeedTpuEngine:
         host-offload optimizer is supported via a fused grads-only program.
         """
         ga = int(self.config.gradient_accumulation_steps)
+        if self._ltd_cfg is not None:
+            self._update_random_ltd()
+        batch = self._apply_curriculum(batch)
+        batch = self._inject_ltd_seed(batch)
         if self._offload is not None:
             return self._fused_offload_step(batch, ga)
         if self._onebit is not None:

@@ -1,0 +1,159 @@
+"""Data-efficiency tests — curriculum sampler/truncation through the engine,
+variable batch+LR, and random-LTD (analog of the reference's
+``tests/unit/runtime/data_efficiency``)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, get_preset
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataEfficiencySampler, VariableBatchDataLoader,
+    VariableBatchLRSchedule, batch_by_tokens, lr_scale_for_batch)
+
+
+def test_curriculum_sampler_respects_difficulty():
+    """Early steps draw only easy samples; late steps draw from everything."""
+    n = 256
+    difficulties = np.arange(n)  # sample i has difficulty i
+    sched = CurriculumScheduler({
+        "min_difficulty": 16, "max_difficulty": 256,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    sampler = DataEfficiencySampler(difficulties, batch_size=8,
+                                    scheduler=sched, seed=0)
+    it = iter(sampler)
+    early = next(it)
+    assert difficulties[early].max() <= 16
+    sampler.set_step(100)
+    late = next(iter(sampler))
+    assert difficulties[late].max() > 64  # full pool reachable
+
+
+def test_curriculum_engine_seqlen_schedule(eight_devices):
+    """The engine truncates batches to the schedule: early steps train on
+    short sequences, difficulty grows across steps (VERDICT done-criterion)."""
+    eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": 8},
+        "data_efficiency": {
+            "enabled": True,
+            "data_sampling": {"enabled": True, "curriculum_learning": {
+                "enabled": True, "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}}}},
+        "steps_per_print": 100})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 32))}
+    seqlens = []
+    for _ in range(5):
+        loss = eng.forward(batch)
+        # the jitted program saw the truncated batch
+        seqlens.append(eng.curriculum_difficulty())
+        eng.backward(loss)
+        eng.step()
+    assert seqlens[0] == 8 and seqlens[-1] == 32
+    assert seqlens == sorted(seqlens), "difficulty must be non-decreasing"
+    assert np.isfinite(float(loss))
+
+
+def test_batch_by_tokens_budget():
+    rng = np.random.default_rng(0)
+    seqlens = rng.integers(16, 257, size=200)
+    batches = batch_by_tokens(seqlens, max_tokens=1024)
+    covered = np.concatenate(batches)
+    assert sorted(covered) == list(range(200))  # partition, no dupes/drops
+    for b in batches:
+        max_len = seqlens[b].max()
+        assert len(b) * max_len <= 1024 or len(b) == 1
+        assert len(b) in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def test_variable_batch_lr_schedule():
+    sched = VariableBatchLRSchedule(lambda step: 1e-3, base_batch_size=8,
+                                    method="linear")
+    sched.set_batch_size(16)
+    assert float(sched(0)) == pytest.approx(2e-3)
+    sched.set_batch_size(4)
+    assert float(sched(0)) == pytest.approx(5e-4)
+    assert lr_scale_for_batch(32, 8, "sqrt") == pytest.approx(2.0)
+
+
+def test_variable_batch_loader_trains(eight_devices):
+    """Token-budget batches + scaled LR drive the engine end to end."""
+    rng = np.random.default_rng(0)
+    # bimodal lengths: short docs pack 32/batch, long docs 8/batch
+    data = [{"input_ids": rng.integers(0, 256, (8 if i < 32 else 64,))}
+            for i in range(64)]
+    seqlens = [len(d["input_ids"]) for d in data]
+
+    def collate(samples):
+        L = max(len(s["input_ids"]) for s in samples)
+        ids = np.zeros((len(samples), L), np.int32)
+        for i, s in enumerate(samples):
+            ids[i, :len(s["input_ids"])] = s["input_ids"]
+        return {"input_ids": ids}
+
+    # bucket sizes divisible by dp=8 so every variable batch shards cleanly
+    loader = VariableBatchDataLoader(data, seqlens, max_tokens=512,
+                                     collate_fn=collate, base_batch_size=16,
+                                     bucket_batch_sizes=[8, 16, 32])
+    base_sched = VariableBatchLRSchedule(lambda s: 1e-3, base_batch_size=16)
+    eng, *_ = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        optimizer=None,
+        lr_scheduler=base_sched,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+                "steps_per_print": 100})
+    sizes = set()
+    for batch, scale in loader:
+        base_sched.set_batch_size(batch["input_ids"].shape[0])
+        sizes.add(batch["input_ids"].shape[0])
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+    assert len(sizes) > 1, "expected variable batch sizes"
+    assert np.isfinite(float(loss))
+
+
+def test_random_ltd_engine(eight_devices):
+    """Random-LTD: kept-token schedule grows across steps, training converges,
+    and keep == T reduces to the dense path."""
+    def build(ltd):
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+            "steps_per_print": 100}
+        if ltd:
+            cfg["data_efficiency"] = {
+                "enabled": True,
+                "data_routing": {"enabled": True, "random_ltd": {
+                    "enabled": True, "min_value": 16, "step_size": 8,
+                    "interval": 2}}}
+        return ds.initialize(model=TransformerLM(get_preset("tiny")),
+                             config=cfg)[0]
+
+    eng = build(True)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 32))}
+    keeps, losses = [], []
+    for _ in range(6):
+        loss = eng.forward(batch)
+        keeps.append(eng.module._ltd_keep)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    assert keeps[0] == 16 and keeps[-1] > keeps[0], keeps
+    assert losses[-1] < losses[0]
+    # keep >= T: dense semantics (ltd branch never taken)
+    eng2 = build(True)
+    eng2._ltd_cfg.min_value = 64  # > T
+    eng2._update_random_ltd()
+    l2 = float(eng2.forward(batch))
+    dense = build(False)
+    ld = float(dense.forward(batch))
+    np.testing.assert_allclose(l2, ld, rtol=1e-5)
