@@ -43,7 +43,12 @@ def initialize(model=None, config=None, optimizer=None, model_parameters=None,
         config = config_params
     ds_config = from_config(config)
     comm.init_distributed()
-    engine = DeepSpeedTpuEngine(
+    engine_cls = DeepSpeedTpuEngine
+    if ds_config.hybrid_engine.enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedTpuHybridEngine
+
+        engine_cls = DeepSpeedTpuHybridEngine
+    engine = engine_cls(
         model=model,
         config=ds_config,
         optimizer=optimizer,

@@ -321,6 +321,21 @@ class DataEfficiencyConfig(DSTpuConfigModel):
     data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
 
 
+class HybridEngineConfig(DSTpuConfigModel):
+    """``hybrid_engine`` section (reference hybrid_engine.py config): RLHF
+    train+generate on shared weights. ``max_out_tokens`` is the default
+    generation cap; the gather/release/pin knobs and ``inference_tp_size``
+    have no TPU meaning (XLA gathers per use; generation runs on the
+    training mesh) and are accepted as compat-only no-ops."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class ElasticityConfig(DSTpuConfigModel):
     """``elasticity`` section (reference ``deepspeed/elasticity/config.py``):
     pick a global batch compatible with many chip counts so training survives
@@ -364,6 +379,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
+    hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
 
     gradient_clipping: float = 0.0
     steps_per_print: int = 10

@@ -23,6 +23,68 @@ from deepspeed_tpu.parallel import sharding as shd
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def sample_token(logits, temperature: float, top_k: int, rng,
+                 with_logprob: bool = False):
+    """Greedy / temperature / top-k sampling of the next token; optionally
+    also the token's logprob under the SAMPLING distribution (the behavior
+    policy — collected here because re-scoring a top-k-filtered distribution
+    later is numerically fragile at the k-th boundary)."""
+    if temperature <= 0.0:
+        tok = jnp.argmax(logits, axis=-1)
+        lp = logits.astype(jnp.float32)
+    else:
+        lp = (logits / temperature).astype(jnp.float32)
+        if top_k > 0:
+            vals, _ = jax.lax.top_k(lp, top_k)
+            lp = jnp.where(lp < vals[:, -1:], -jnp.inf, lp)
+        tok = jax.random.categorical(rng, lp, axis=-1)
+    if not with_logprob:
+        return tok
+    logp = jax.nn.log_softmax(lp, axis=-1)
+    return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+
+
+def generate_loop(step_fn, params, mesh, init_cache_fn, ids: np.ndarray,
+                  total: int, temperature: float, top_k: int, seed: int,
+                  eos_token_id: Optional[int],
+                  return_logprobs: bool = False):
+    """The autoregressive prefill+decode loop shared by the inference and
+    hybrid engines: jitted prefill, per-token sample, pad-with-EOS after a
+    sequence finishes, early exit when all are done. With
+    ``return_logprobs``, also returns the behavior-policy logprob of every
+    generated token (forced post-EOS pads get 0.0 — mask them)."""
+    B, T = ids.shape
+    cache = init_cache_fn(B, total)
+    rng = jax.random.key(seed)
+    with jax.sharding.set_mesh(mesh):
+        logits, cache = step_fn(params, jnp.asarray(ids), cache)
+        next_logits = logits[:, -1]
+        out = [ids]
+        lps = []
+        finished = np.zeros((B,), bool)
+        for _ in range(total - T):
+            rng, sub = jax.random.split(rng)
+            nxt, lp = sample_token(next_logits, temperature, top_k, sub,
+                                   with_logprob=True)
+            nxt_np = np.asarray(nxt)
+            lp_np = np.asarray(lp)
+            if eos_token_id is not None:
+                lp_np = np.where(finished, 0.0, lp_np)
+                nxt_np = np.where(finished, eos_token_id, nxt_np)
+                finished |= nxt_np == eos_token_id
+            out.append(nxt_np[:, None])
+            lps.append(lp_np[:, None])
+            if eos_token_id is not None and finished.all():
+                break
+            logits, cache = step_fn(params, jnp.asarray(nxt_np)[:, None],
+                                    cache)
+            next_logits = logits[:, -1]
+    seqs = np.concatenate(out, axis=1)
+    if return_logprobs:
+        return seqs, np.concatenate(lps, axis=1)
+    return seqs
+
+
 class InferenceEngine:
     def __init__(self, model: TransformerLM, config=None, params=None,
                  topology: Optional[Topology] = None, dtype=None,
@@ -63,37 +125,10 @@ class InferenceEngine:
                  top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None):
         """Greedy / top-k sampled generation with a static KV cache."""
         ids = np.asarray(input_ids)
-        B, T = ids.shape
-        total = min(self.max_seq_len, T + max_new_tokens)
-        cache = self.module.init_kv_cache(B, total)
-        rng = jax.random.key(seed)
+        total = min(self.max_seq_len, ids.shape[1] + max_new_tokens)
+        return generate_loop(self._step, self.params, self.mesh,
+                             self.module.init_kv_cache, ids, total,
+                             temperature, top_k, seed, eos_token_id)
 
-        with jax.sharding.set_mesh(self.mesh):
-            logits, cache = self._step(self.params, jnp.asarray(ids), cache)
-            next_logits = logits[:, -1]
-            out = [ids]
-            finished = np.zeros((B,), bool)
-            for i in range(total - T):
-                rng, sub = jax.random.split(rng)
-                nxt = self._sample(next_logits, temperature, top_k, sub)
-                nxt_np = np.asarray(nxt)
-                if eos_token_id is not None:
-                    nxt_np = np.where(finished, eos_token_id, nxt_np)
-                    finished |= nxt_np == eos_token_id
-                out.append(nxt_np[:, None])
-                if eos_token_id is not None and finished.all():
-                    break
-                logits, cache = self._step(self.params, jnp.asarray(nxt_np)[:, None],
-                                           cache)
-                next_logits = logits[:, -1]
-        return np.concatenate(out, axis=1)
-
-    @staticmethod
-    def _sample(logits, temperature, top_k, rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k > 0:
-            vals, _ = jax.lax.top_k(logits, top_k)
-            logits = jnp.where(logits < vals[:, -1:], -jnp.inf, logits)
-        return jax.random.categorical(rng, logits, axis=-1)
+    # back-compat alias (hybrid engine + older call sites)
+    _sample = staticmethod(sample_token)
