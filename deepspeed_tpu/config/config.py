@@ -321,6 +321,14 @@ class DataEfficiencyConfig(DSTpuConfigModel):
     data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
 
 
+class ProgressiveLayerDropConfig(DSTpuConfigModel):
+    """``progressive_layer_drop`` section (reference config schema)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class HybridEngineConfig(DSTpuConfigModel):
     """``hybrid_engine`` section (reference hybrid_engine.py config): RLHF
     train+generate on shared weights. ``max_out_tokens`` is the default
@@ -380,6 +388,8 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = Field(
+        default_factory=ProgressiveLayerDropConfig)
 
     gradient_clipping: float = 0.0
     steps_per_print: int = 10

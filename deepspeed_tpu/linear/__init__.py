@@ -1,0 +1,10 @@
+"""LoRA / quantized-base optimized linear layers.
+
+Parity target: ``deepspeed/linear/`` — ``OptimizedLinear``
+(optimized_linear.py:17), ``LoRAConfig``/``QuantizationConfig`` (config.py).
+"""
+
+from deepspeed_tpu.linear.optimized_linear import (  # noqa: F401
+    LoRAConfig, OptimizedLinear, QuantizationConfig, lora_merge,
+    lora_trainable_mask, lora_wrap_params,
+)

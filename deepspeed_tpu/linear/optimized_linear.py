@@ -1,0 +1,172 @@
+"""OptimizedLinear: LoRA adapters over a frozen (optionally quantized) base.
+
+Parity target: ``deepspeed/linear/optimized_linear.py:17`` ``OptimizedLinear``
++ ``linear/config.py`` (``LoRAConfig``, ``QuantizationConfig``). The torch
+version swaps nn.Linear modules for LoRAOptimizedLinear with a
+ZeRO-3-gathered, possibly fp8/int8-quantized frozen base weight and trainable
+low-rank adapters. TPU-native design: functional params —
+
+  {"base": int8 codes (+"scale") or fp weight, "lora_a": [in, r],
+   "lora_b": [r, out]}
+
+``apply`` dequantizes the base on the fly (XLA fuses the dequant into the
+matmul) and adds ``(x @ A) @ B * alpha/r``. Freezing = optimizer masking:
+:func:`lora_trainable_mask` yields the optax/`zero_grads` mask; only adapters
+carry optimizer state. :func:`lora_wrap_params` retrofits an existing
+TransformerLM param tree (the module-injection analog), and
+:func:`lora_merge` folds trained adapters back into dense weights for export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantization import (dequantize_blockwise,
+                                            quantize_blockwise)
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """linear/config.py QuantizationConfig: base-weight quantization."""
+
+    q_bits: int = 8              # 4 or 8 (blockwise int); 0 = no quantization
+    group_size: int = 512
+
+    @property
+    def enabled(self) -> bool:
+        return self.q_bits in (4, 8)
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """linear/config.py LoRAConfig."""
+
+    lora_r: int = 8
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1   # informational: base keeps its model specs
+    offload: bool = False           # n/a-tpu: base lives sharded in HBM
+    quantization: Optional[QuantizationConfig] = None
+
+
+class OptimizedLinear:
+    """Functional LoRA linear: init/apply over a params dict."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 lora: Optional[LoRAConfig] = None):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.lora = lora or LoRAConfig()
+
+    def init(self, rng: jax.Array, base_weight: Optional[jax.Array] = None
+             ) -> dict:
+        ka, kw = jax.random.split(rng)
+        if base_weight is None:
+            base_weight = jax.random.normal(
+                kw, (self.in_features, self.out_features),
+                jnp.float32) / math.sqrt(self.in_features)
+        params = {"lora_a": jax.random.normal(
+            ka, (self.in_features, self.lora.lora_r),
+            jnp.float32) / math.sqrt(self.in_features),
+            "lora_b": jnp.zeros((self.lora.lora_r, self.out_features),
+                                jnp.float32)}
+        q = self.lora.quantization
+        if q is not None and q.enabled:
+            codes, scale = quantize_blockwise(base_weight, bits=q.q_bits,
+                                              group_size=q.group_size)
+            params["base_q"] = codes
+            params["base_scale"] = scale
+        else:
+            params["base"] = base_weight
+        return params
+
+    def _base(self, params: dict, dtype) -> jax.Array:
+        if "base" in params:
+            return params["base"].astype(dtype)
+        q = self.lora.quantization
+        return dequantize_blockwise(
+            params["base_q"], params["base_scale"], bits=q.q_bits,
+            shape=(self.in_features, self.out_features), dtype=dtype)
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        w = self._base(params, x.dtype)
+        scaling = self.lora.lora_alpha / self.lora.lora_r
+        return x @ w + (x @ params["lora_a"].astype(x.dtype)) \
+            @ params["lora_b"].astype(x.dtype) * scaling
+
+    __call__ = apply
+
+
+# ---------------------------------------------------------------------------
+# param-tree retrofitting (the module-injection analog for our model family)
+# ---------------------------------------------------------------------------
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _is_target(path: Tuple, targets: Sequence[str]) -> bool:
+    leaf_name = str(getattr(path[-1], "key", path[-1])) if path else ""
+    return leaf_name in targets
+
+
+def lora_wrap_params(params: Any, rng: jax.Array, lora: LoRAConfig,
+                     targets: Sequence[str] = DEFAULT_TARGETS) -> Any:
+    """Replace each targeted 2-D/stacked-3-D weight leaf ``w`` with
+    ``{"base": w, "lora_a": ..., "lora_b": ...}`` (adapters zero-initialized on
+    B, so the wrapped model starts exactly equal to the base model)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = jax.random.split(rng, len(flat))
+    out = []
+    for (path, leaf), key in zip(flat, keys):
+        if _is_target(path, targets) and leaf.ndim in (2, 3):
+            fan_in, fan_out = leaf.shape[-2], leaf.shape[-1]
+            lead = leaf.shape[:-2]
+            a = jax.random.normal(key, lead + (fan_in, lora.lora_r),
+                                  jnp.float32) / math.sqrt(fan_in)
+            b = jnp.zeros(lead + (lora.lora_r, fan_out), jnp.float32)
+            out.append({"base": leaf, "lora_a": a, "lora_b": b})
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def lora_apply_leaf(wrapped: Any, x: jax.Array, lora: LoRAConfig) -> jax.Array:
+    """``x @ W_effective`` for one wrapped leaf (helper for model forwards)."""
+    scaling = lora.lora_alpha / lora.lora_r
+    return x @ wrapped["base"] + (x @ wrapped["lora_a"]) \
+        @ wrapped["lora_b"] * scaling
+
+
+def lora_effective_weight(wrapped: Any, lora: LoRAConfig) -> jax.Array:
+    scaling = lora.lora_alpha / lora.lora_r
+    return wrapped["base"] + wrapped["lora_a"] @ wrapped["lora_b"] * scaling
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """True for adapter leaves, False for base/frozen weights — feed to
+    ``optax.masked`` / ``optax.multi_transform`` so only adapters train
+    (the reference freezes base weights with requires_grad=False)."""
+    def mask(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1])) if path else ""
+        return name in ("lora_a", "lora_b")
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [mask(p, l) for p, l in flat])
+
+
+def lora_merge(params: Any, lora: LoRAConfig) -> Any:
+    """Fold adapters into dense weights (export / serve without LoRA)."""
+    def is_wrapped(x):
+        return isinstance(x, dict) and "lora_a" in x and "base" in x
+
+    def merge(x):
+        if is_wrapped(x):
+            return lora_effective_weight(x, lora)
+        return x
+
+    return jax.tree_util.tree_map(merge, params, is_leaf=is_wrapped)

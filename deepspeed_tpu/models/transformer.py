@@ -407,7 +407,8 @@ class TransformerLM:
     # ---- forward ----------------------------------------------------------
     def logits(self, params: Params, input_ids: jax.Array,
                positions: Optional[jax.Array] = None,
-               ltd_seed: Optional[jax.Array] = None) -> jax.Array:
+               ltd_seed: Optional[jax.Array] = None,
+               pld_theta: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         x = params["embed"]["tokens"].astype(dt)[input_ids]
@@ -431,16 +432,18 @@ class TransformerLM:
         T = input_ids.shape[1]
         ltd_keep = self._ltd_keep
         ltd = ltd_keep is not None and ltd_keep < T
-        if ltd:
-            # random layerwise token dropping: per-LTD-layer random sorted
-            # token subset; the subset runs the block (causal order and RoPE
-            # positions preserved), dropped tokens skip via the residual.
-            # Key = step seed (engine-provided, fresh per step/epoch) folded
-            # with batch content (fresh per microbatch).
-            start_l, end_l = self._ltd_layers
+        if ltd or pld_theta is not None:
+            # shared routing key for LTD/PLD: step seed (engine-provided,
+            # fresh per step/epoch) folded with batch content (fresh per
+            # microbatch)
             seed = jnp.uint32(0) if ltd_seed is None else ltd_seed
             key0 = jax.random.fold_in(jax.random.PRNGKey(seed),
                                       jnp.sum(input_ids).astype(jnp.uint32))
+        if ltd:
+            # random layerwise token dropping: per-LTD-layer random sorted
+            # token subset; the subset runs the block (causal order and RoPE
+            # positions preserved), dropped tokens skip via the residual
+            start_l, end_l = self._ltd_layers
 
             def ltd_block(h, layer_w, li):
                 key = jax.random.fold_in(key0, li)
@@ -462,6 +465,27 @@ class TransformerLM:
                     carry, layer_w, li)
 
             xs = (layers, jnp.arange(cfg.num_layers))
+        elif pld_theta is not None:
+            # progressive layer drop (runtime/progressive_layer_drop.py):
+            # deeper layers are dropped with growing probability. Implemented
+            # as a gated residual (compute-and-mask) rather than lax.cond:
+            # differentiating a data-dependent cond around the Pallas flash
+            # kernel is unsupported, so PLD here keeps the stochastic-depth
+            # REGULARIZATION but not the reference's wall-clock saving.
+            L = cfg.num_layers
+
+            def body(carry, xs):
+                layer_w, li = xs
+                keep_p = 1.0 - ((li.astype(jnp.float32) + 1.0) / L) \
+                    * (1.0 - pld_theta)
+                keep = jax.random.bernoulli(jax.random.fold_in(key0, li),
+                                            keep_p)
+                y, aux = transformer_block(carry, layer_w, cfg, freqs,
+                                           attn_fn, self.moe_fn)
+                x_new = jnp.where(keep, y, carry)
+                return x_new, jnp.where(keep, aux, 0.0)
+
+            xs = (layers, jnp.arange(cfg.num_layers))
         else:
             def body(carry, xs):
                 y, aux = transformer_block(carry, xs, cfg, freqs, attn_fn,
@@ -471,6 +495,7 @@ class TransformerLM:
             xs = layers
 
         body = _maybe_remat(body, cfg.remat_policy)
+        wrapped = ltd or pld_theta is not None
         if cfg.scan_layers:
             x, auxes = jax.lax.scan(body, x, xs)
             aux_total = jnp.sum(auxes)
@@ -478,7 +503,7 @@ class TransformerLM:
             aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
                 xi = jax.tree_util.tree_map(lambda p: p[i], layers)
-                x, aux = body(x, (xi, jnp.int32(i)) if ltd else xi)
+                x, aux = body(x, (xi, jnp.int32(i)) if wrapped else xi)
                 aux_total = aux_total + aux
         x = _norm(x, {k: v for k, v in params["final_norm"].items()}, cfg.norm,
                   cfg.norm_eps)
@@ -492,8 +517,10 @@ class TransformerLM:
                 rng: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         seed = batch.get("ltd_seed")
+        pld = batch.get("pld_theta")
         logits = self.logits(params, batch["input_ids"],
-                             ltd_seed=None if seed is None else seed[0])
+                             ltd_seed=None if seed is None else seed[0],
+                             pld_theta=None if pld is None else pld[0])
         loss = lm_loss(cfg, logits, batch)
         aux = getattr(self, "_last_aux_loss", None)
         if aux is not None and cfg.num_experts > 1:

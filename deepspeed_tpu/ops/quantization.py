@@ -75,6 +75,79 @@ def dequantize_fp8(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Ar
 
 
 # ---------------------------------------------------------------------------
+# FP6 (e3m2) — csrc/fp_quantizer parity. No native 6-bit dtype exists, so
+# values quantize to the 64-entry e3m2 grid (1 sign, 3 exponent, 2 mantissa,
+# bias 3, subnormals at e=0) with a per-tensor absmax scale, and 6-bit codes
+# pack 4-into-3 bytes for true 0.75 B/element storage.
+# ---------------------------------------------------------------------------
+
+def _fp6_grid() -> jax.Array:
+    """The 32 non-negative representable |values| of e3m2, ascending."""
+    import numpy as _np
+
+    vals = []
+    for e in range(8):
+        for m in range(4):
+            if e == 0:
+                vals.append((m / 4.0) * 2.0 ** (1 - 3))  # subnormal
+            else:
+                vals.append((1 + m / 4.0) * 2.0 ** (e - 3))
+    return jnp.asarray(_np.array(vals, _np.float32))
+
+
+def quantize_fp6(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """→ (uint8 codes [n] with sign in bit 5, fp32 scalar scale).
+
+    The scale maps absmax onto the grid top ((1+3/4)·2^4 = 28.0), mirroring
+    the fp8 path.
+    """
+    grid = _fp6_grid()
+    flat = x.reshape(-1).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(flat))
+    scale = jnp.maximum(absmax / grid[-1], 1e-12)
+    y = flat / scale
+    mag = jnp.abs(y)
+    # nearest grid entry: searchsorted against midpoints
+    mids = (grid[1:] + grid[:-1]) * 0.5
+    idx = jnp.searchsorted(mids, mag).astype(jnp.uint8)
+    sign = (y < 0).astype(jnp.uint8)
+    return (sign << 5) | idx, scale
+
+
+def dequantize_fp6(codes: jax.Array, scale: jax.Array,
+                   shape: Tuple[int, ...] = None,
+                   dtype=jnp.bfloat16) -> jax.Array:
+    grid = _fp6_grid()
+    mag = grid[(codes & 0x1F).astype(jnp.int32)]
+    sgn = jnp.where((codes >> 5) & 1, -1.0, 1.0)
+    out = sgn * mag * scale
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def pack_fp6(codes: jax.Array) -> jax.Array:
+    """4 six-bit codes → 3 bytes; zero-pads to a multiple of 4 (unpack_fp6's
+    ``n`` argument drops the tail)."""
+    pad = (-codes.size) % 4
+    if pad:
+        codes = jnp.concatenate([codes.reshape(-1),
+                                 jnp.zeros((pad,), codes.dtype)])
+    c = codes.reshape(-1, 4).astype(jnp.uint32)
+    word = (c[:, 0] << 18) | (c[:, 1] << 12) | (c[:, 2] << 6) | c[:, 3]
+    return jnp.stack([(word >> 16) & 0xFF, (word >> 8) & 0xFF, word & 0xFF],
+                     axis=1).astype(jnp.uint8).reshape(-1)
+
+
+def unpack_fp6(packed: jax.Array, n: int) -> jax.Array:
+    b = packed.reshape(-1, 3).astype(jnp.uint32)
+    word = (b[:, 0] << 16) | (b[:, 1] << 8) | b[:, 2]
+    c = jnp.stack([(word >> 18) & 0x3F, (word >> 12) & 0x3F,
+                   (word >> 6) & 0x3F, word & 0x3F], axis=1)
+    return c.reshape(-1)[:n].astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
 # Quantized collectives (ZeRO++ qwZ / qgZ parity) — call inside shard_map.
 # ---------------------------------------------------------------------------
 

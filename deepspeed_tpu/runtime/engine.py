@@ -194,6 +194,17 @@ class DeepSpeedTpuEngine:
                 raise ValueError("random_ltd requires a model with "
                                  "set_random_ltd (TransformerLM family)")
             self._update_random_ltd()
+        self._pld = None
+        if config.progressive_layer_drop.enabled:
+            if self._ltd_cfg is not None:
+                raise ValueError("progressive_layer_drop and random_ltd both "
+                                 "rewrite the layer loop; enable one")
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+
+            self._pld = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma)
 
         self.training_dataloader = None
         if training_data is not None:
@@ -457,14 +468,20 @@ class DeepSpeedTpuEngine:
         return out
 
     def _inject_ltd_seed(self, batch):
-        """Fresh per-step randomness for random-LTD token subsets: the step
-        counter rides the batch (broadcast per example so the fused GA reshape
-        works) and the model folds it with the content hash."""
-        if self._ltd_cfg is None or not isinstance(batch, dict):
+        """Per-step routing inputs riding the batch (broadcast per example so
+        the fused GA reshape works): the random-LTD/PLD step seed, and the
+        progressive-layer-drop theta (a traced scalar — no recompiles as it
+        decays)."""
+        if (self._ltd_cfg is None and self._pld is None) \
+                or not isinstance(batch, dict):
             return batch
         b = np.asarray(batch["input_ids"]).shape[0]
-        return {**batch, "ltd_seed": np.full((b,), self.global_steps
-                                             + self.micro_steps, np.int32)}
+        out = {**batch, "ltd_seed": np.full((b,), self.global_steps
+                                            + self.micro_steps, np.int32)}
+        if self._pld is not None:
+            self._pld.update_state(self.global_steps)
+            out["pld_theta"] = np.full((b,), self._pld.get_theta(), np.float32)
+        return out
 
     def _put_batch(self, batch):
         """Host batch → device arrays laid out over (dp, fsdp) × sp."""

@@ -1,0 +1,43 @@
+"""Progressive Layer Dropping (PLD).
+
+Parity target: ``deepspeed/runtime/progressive_layer_drop.py`` —
+``theta(t) = (1 - theta_min) * exp(-gamma * t) + theta_min`` controls the
+global keep probability; per-layer keep follows the PLD paper's depth ramp
+``p_i = 1 - (i / L) * (1 - theta)``.
+
+The schedule object mirrors the reference API (``update_state``/``get_theta``/
+``get_state``); the stochastic-depth application lives in the model: pass
+``pld_theta`` through the batch (like the random-LTD seed) and blocks are
+skipped with probability ``1 - p_i`` during training.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})")
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = ((1.0 - self.theta)
+                              * float(np.exp(-self.gamma * global_step))
+                              + self.theta)
+
+
+def layer_keep_probs(theta: float, num_layers: int) -> np.ndarray:
+    """Per-layer keep probability under the PLD depth ramp."""
+    i = np.arange(1, num_layers + 1)
+    return 1.0 - (i / num_layers) * (1.0 - theta)

@@ -1,0 +1,193 @@
+"""OptimizedLinear/LoRA, progressive layer drop, eigenvalue, and fp6 tests
+(analogs of the reference's ``tests/unit/linear``, PLD schedule tests, and
+fp_quantizer tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                  QuantizationConfig, lora_merge,
+                                  lora_trainable_mask, lora_wrap_params)
+from deepspeed_tpu.ops.quantization import (dequantize_fp6, pack_fp6,
+                                            quantize_fp6, unpack_fp6)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          layer_keep_probs)
+
+
+# ---------------------------------------------------------------------------
+# LoRA / OptimizedLinear
+# ---------------------------------------------------------------------------
+
+def test_optimized_linear_starts_at_base():
+    """Zero-init B: the LoRA layer equals the base linear at init."""
+    lin = OptimizedLinear(16, 32, LoRAConfig(lora_r=4))
+    p = lin.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (3, 16))
+    np.testing.assert_allclose(np.asarray(lin.apply(p, x)),
+                               np.asarray(x @ p["base"]), atol=1e-6)
+
+
+def test_optimized_linear_quantized_base():
+    lin = OptimizedLinear(64, 32, LoRAConfig(
+        lora_r=4, quantization=QuantizationConfig(q_bits=8, group_size=64)))
+    p = lin.init(jax.random.key(0))
+    assert "base" not in p and p["base_q"].dtype == jnp.int8
+    x = jax.random.normal(jax.random.key(1), (3, 64))
+    dense = OptimizedLinear(64, 32, LoRAConfig(lora_r=4))
+    pd = dense.init(jax.random.key(0))
+    # int8 base tracks the dense base within quant tolerance
+    np.testing.assert_allclose(np.asarray(lin.apply(p, x)),
+                               np.asarray(dense.apply(pd, x)),
+                               atol=0.05, rtol=0.05)
+
+
+def test_lora_wrap_train_merge(eight_devices):
+    """The LoRA fine-tuning loop: wrap → train adapters only → merge."""
+    import optax
+
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.linear.optimized_linear import lora_effective_weight
+
+    model = TransformerLM(get_preset("tiny"))
+    params = model.init(jax.random.key(0))
+    lora = LoRAConfig(lora_r=4, lora_alpha=8.0)
+    wrapped = lora_wrap_params(params, jax.random.key(1), lora)
+    assert "lora_a" in wrapped["layers"]["attn"]["wq"]
+    # merged(init) == original (B zero-init)
+    merged0 = lora_merge(wrapped, lora)
+    np.testing.assert_allclose(
+        np.asarray(merged0["layers"]["attn"]["wq"]),
+        np.asarray(params["layers"]["attn"]["wq"]), atol=1e-6)
+
+    mask = lora_trainable_mask(wrapped)
+    tx = optax.multi_transform(
+        {"train": optax.adam(1e-2), "freeze": optax.set_to_zero()},
+        jax.tree_util.tree_map(lambda m: "train" if m else "freeze", mask))
+    opt_state = tx.init(wrapped)
+
+    def loss_fn(w):
+        eff = lora_merge(w, lora)
+        return model.loss_fn(eff, {"input_ids": np.arange(32).reshape(1, 32)})
+
+    w = wrapped
+    losses = []
+    for _ in range(5):
+        loss, grads = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = tx.update(grads, opt_state, w)
+        w = optax.apply_updates(w, updates)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # frozen base untouched; adapters moved
+    np.testing.assert_array_equal(
+        np.asarray(w["layers"]["attn"]["wq"]["base"]),
+        np.asarray(wrapped["layers"]["attn"]["wq"]["base"]))
+    assert np.abs(np.asarray(w["layers"]["attn"]["wq"]["lora_b"])).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# Progressive layer drop
+# ---------------------------------------------------------------------------
+
+def test_pld_schedule_matches_reference_formula():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    pld.update_state(10_000)
+    want = 0.5 * np.exp(-0.001 * 10_000) + 0.5
+    assert pld.get_theta() == pytest.approx(want)
+    probs = layer_keep_probs(0.5, 4)
+    np.testing.assert_allclose(probs, [0.875, 0.75, 0.625, 0.5])
+
+
+def test_pld_engine_training(eight_devices):
+    """PLD under the engine: theta decays across steps, training converges,
+    and theta=1 reproduces the dense loss."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.1},
+        "steps_per_print": 100})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 32))}
+    thetas, losses = [], []
+    for _ in range(5):
+        loss = eng.forward(batch)
+        thetas.append(eng._pld.get_theta())
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    assert thetas == sorted(thetas, reverse=True) and thetas[-1] < 1.0
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Eigenvalue (Hessian power iteration)
+# ---------------------------------------------------------------------------
+
+def test_eigenvalue_quadratic_exact():
+    """For loss = 0.5 x^T A x the Hessian IS A: power iteration must find its
+    top eigenvalue."""
+    rng = np.random.default_rng(0)
+    Q = np.linalg.qr(rng.normal(size=(8, 8)))[0]
+    eigs = np.array([5.0, 3.0, 2.0, 1.0, 0.5, 0.2, 0.1, 0.05])
+    A = (Q * eigs) @ Q.T
+
+    def loss_fn(params, batch):
+        x = params["x"]
+        return 0.5 * x @ jnp.asarray(A, jnp.float32) @ x
+
+    ev = Eigenvalue(max_iter=200, tol=1e-5)
+    lam, vec = ev.compute_eigenvalue(loss_fn, {"x": jnp.zeros(8)}, None)
+    assert lam == pytest.approx(5.0, rel=1e-2)
+
+
+def test_eigenvalue_on_model_loss(eight_devices):
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    model = TransformerLM(get_preset("tiny"))
+    params = model.init(jax.random.key(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (2, 16))}
+    lam, _ = Eigenvalue(max_iter=8, tol=1e-2).compute_eigenvalue(
+        model.loss_fn, params, batch)
+    assert np.isfinite(lam) and lam > 0
+
+
+# ---------------------------------------------------------------------------
+# FP6
+# ---------------------------------------------------------------------------
+
+def test_fp6_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.key(0), (1024,)) * 3.0
+    codes, scale = quantize_fp6(x)
+    back = dequantize_fp6(codes, scale, dtype=jnp.float32)
+    # e3m2: 2 mantissa bits → relative error <= 2^-3 in the normal range
+    # (values below the smallest subnormal flush to zero, as in any float fmt)
+    xa = np.abs(np.asarray(x))
+    rel = np.abs(np.asarray(back) - np.asarray(x)) / (xa + 1e-3)
+    assert np.median(rel) < 0.125
+    assert rel[xa > 0.1 * xa.max()].max() < 0.15
+
+
+def test_fp6_pack_unpack_identity():
+    codes = jnp.asarray(np.random.default_rng(0).integers(0, 64, 256),
+                        jnp.uint8)
+    packed = pack_fp6(codes)
+    assert packed.size == 256 * 3 // 4  # true 6-bit storage
+    np.testing.assert_array_equal(np.asarray(unpack_fp6(packed, 256)),
+                                  np.asarray(codes))
+
+
+def test_fp6_preserves_sign_and_order():
+    x = jnp.asarray([-8.0, -1.0, -0.01, 0.0, 0.01, 1.0, 8.0])
+    codes, scale = quantize_fp6(x)
+    back = np.asarray(dequantize_fp6(codes, scale, dtype=jnp.float32))
+    assert (np.sign(back) == np.sign(np.asarray(x))).all()
+    assert (np.diff(back) >= 0).all()
