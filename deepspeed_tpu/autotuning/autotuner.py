@@ -30,31 +30,53 @@ class TrialResult:
 
 
 class Autotuner:
-    """Grid search over micro-batch × zero-stage × remat (tuner/ grid parity)."""
+    """Grid search over micro-batch × zero-stage × remat × offload (the
+    reference tuner's axis set). Offload combos run only at stage >= 1;
+    remat candidates apply when ``model_factory`` accepts ``remat_policy``."""
 
-    def __init__(self, model_factory: Callable[[], Any], base_config: Dict[str, Any],
+    def __init__(self, model_factory: Callable[..., Any], base_config: Dict[str, Any],
                  micro_batch_candidates: Sequence[int] = (1, 2, 4, 8),
                  zero_stage_candidates: Sequence[int] = (0, 1, 2, 3),
                  remat_candidates: Sequence[str] = ("none",),
+                 offload_candidates: Sequence[Optional[str]] = (None,),
                  steps: int = 3, make_batch: Optional[Callable[[int], Any]] = None):
         self.model_factory = model_factory
         self.base_config = base_config
         self.micro_batch_candidates = list(micro_batch_candidates)
         self.zero_stage_candidates = list(zero_stage_candidates)
         self.remat_candidates = list(remat_candidates)
+        self.offload_candidates = list(offload_candidates)
         self.steps = steps
         self.make_batch = make_batch
         self.results: List[TrialResult] = []
+        # model_factory(remat_policy=...) only when it accepts it
+        import inspect
 
-    def _run_trial(self, mb: int, stage: int) -> TrialResult:
+        try:
+            sig = inspect.signature(model_factory)
+            self._factory_takes_remat = ("remat_policy" in sig.parameters
+                                         or any(p.kind == p.VAR_KEYWORD
+                                                for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            self._factory_takes_remat = False
+
+    def _run_trial(self, mb: int, stage: int, remat: str,
+                   offload: Optional[str]) -> TrialResult:
         import deepspeed_tpu as ds
 
+        key = {"micro_batch": mb, "stage": stage, "remat": remat,
+               "offload": offload}
         cfg = copy.deepcopy(self.base_config)
         cfg["train_micro_batch_size_per_gpu"] = mb
         cfg.pop("train_batch_size", None)
-        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        zo = cfg.setdefault("zero_optimization", {})
+        zo["stage"] = stage
+        if offload:
+            zo["offload_optimizer"] = {"device": offload}
         try:
-            engine, *_ = ds.initialize(model=self.model_factory(), config=cfg)
+            model = (self.model_factory(remat_policy=remat)
+                     if self._factory_takes_remat else self.model_factory())
+            engine, *_ = ds.initialize(model=model, config=cfg)
             batch = self.make_batch(mb * engine.topology.dp_world_size)
             engine.fused_train_step(batch)  # compile + warm
             t0 = time.perf_counter()
@@ -63,17 +85,25 @@ class Autotuner:
             loss.block_until_ready()
             dt = time.perf_counter() - t0
             sps = self.steps * engine.train_batch_size() / dt
-            return TrialResult({"micro_batch": mb, "stage": stage}, True, sps)
+            return TrialResult(key, True, sps)
         except Exception as e:  # OOM / invalid combo → rejected candidate
-            return TrialResult({"micro_batch": mb, "stage": stage}, False,
-                               error=str(e)[:200])
+            return TrialResult(key, False, error=str(e)[:200])
 
     def tune(self) -> Optional[TrialResult]:
-        """Return the fastest working (micro_batch, stage) combo."""
+        """Return the fastest working (micro_batch, stage, remat, offload)
+        combo — the reference tuner's full axis set (autotuner.py:42)."""
         assert self.make_batch is not None, "make_batch factory is required"
-        for mb, stage in itertools.product(self.micro_batch_candidates,
-                                           self.zero_stage_candidates):
-            r = self._run_trial(mb, stage)
+        remats = (self.remat_candidates
+                  if self._factory_takes_remat else ["none"])
+        if not self._factory_takes_remat and self.remat_candidates != ["none"]:
+            log_dist("autotune: model_factory does not accept remat_policy; "
+                     "remat candidates skipped")
+        for mb, stage, remat, off in itertools.product(
+                self.micro_batch_candidates, self.zero_stage_candidates,
+                remats, self.offload_candidates):
+            if off and stage < 1:
+                continue  # offload_optimizer needs a zero shard layout
+            r = self._run_trial(mb, stage, remat, off)
             self.results.append(r)
             log_dist(f"autotune trial {r.config}: "
                      f"{'%.1f samples/s' % r.samples_per_sec if r.ok else 'FAIL ' + r.error}")

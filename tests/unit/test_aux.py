@@ -117,3 +117,47 @@ class TestProfiler:
         stats = profile_fn(f, jnp.ones((64, 64)), jnp.ones((64, 64)))
         # 2*64^3 flops expected (cost analysis may fold, allow wide band)
         assert stats["flops"] > 1e4
+
+
+class TestAutotuner:
+    def test_grid_sweeps_all_axes(self, eight_devices):
+        """The tuner enumerates micro-batch x stage x remat x offload (the
+        reference tuner's full axis set) and returns the fastest OK trial."""
+        from deepspeed_tpu.autotuning import Autotuner
+        from deepspeed_tpu.models import TransformerLM, TransformerConfig
+
+        def factory(remat_policy="none"):
+            return TransformerLM(TransformerConfig(
+                vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=32, remat_policy=remat_policy))
+
+        tuner = Autotuner(
+            factory,
+            {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "mesh": {"dp": 8}, "steps_per_print": 1000},
+            micro_batch_candidates=(1, 2),
+            zero_stage_candidates=(0, 1),
+            remat_candidates=("none", "full"),
+            offload_candidates=(None, "cpu"),
+            steps=1,
+            make_batch=lambda n: {"input_ids": np.zeros((n, 16), np.int32)})
+        best = tuner.tune()
+        assert best is not None and best.ok
+        axes = {(r.config["micro_batch"], r.config["stage"],
+                 r.config["remat"], r.config["offload"])
+                for r in tuner.results}
+        # offload trials only run at stage >= 1
+        assert (1, 1, "none", "cpu") in axes
+        assert all(off is None or stage >= 1
+                   for (_, stage, _, off) in axes)
+        assert {r.config["remat"] for r in tuner.results} == {"none", "full"}
+
+
+class TestAIOBench:
+    def test_sweep(self, tmp_path):
+        from deepspeed_tpu.ops.aio_bench import sweep
+
+        res = sweep(str(tmp_path), sizes_mb=[1], threads=[1, 2], repeats=2)
+        assert len(res) == 2
+        for r in res:
+            assert r["write_MBps"] > 0 and r["read_MBps"] > 0
