@@ -125,15 +125,23 @@ def get_attention_impl(name: str) -> Callable:
     return _ATTENTION_IMPLS[name]
 
 
+def repeat_kv(k: jax.Array, v: jax.Array, num_heads: int):
+    """GQA: tile kv heads up to ``num_heads`` (no-op for MHA). The single
+    source of the head-repeat convention — every attention path uses it."""
+    K = k.shape[2]
+    if K != num_heads:
+        rep = num_heads // K
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Reference attention: q[B,T,H,d], k/v[B,S,K,d] → [B,T,H,d]. GQA via head repeat."""
     B, T, H, d = q.shape
-    S, K = k.shape[1], k.shape[2]
-    if K != H:
-        rep = H // K
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    S = k.shape[1]
+    k, v = repeat_kv(k, v, H)
     scores = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(d)
     mask = None
     if causal:
@@ -239,11 +247,7 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
 def _cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       valid: jax.Array) -> jax.Array:
     """Attention over a padded KV cache; valid: [B, t, S] bool per query row."""
-    H, K = q.shape[2], k.shape[2]
-    if K != H:
-        rep = H // K
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = repeat_kv(k, v, q.shape[2])
     scores = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(q.shape[-1])
     scores = jnp.where(valid[:, None], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -253,11 +257,7 @@ def _cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      valid: jax.Array) -> jax.Array:
     """Attention over a (padded) KV cache; valid: [1|B, S] bool."""
-    H, K = q.shape[2], k.shape[2]
-    if K != H:
-        rep = H // K
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = repeat_kv(k, v, q.shape[2])
     scores = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(q.shape[-1])
     scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)

@@ -1,0 +1,172 @@
+"""Block-sparse attention with static layouts (Fixed / BigBird / Longformer).
+
+Parity target: ``deepspeed/ops/sparse_attention/`` (SparsityConfig family:
+``FixedSparsityConfig``, ``BigBirdSparsityConfig``, ``BSLongformerSparsityConfig``)
++ ``csrc/sparse_attention`` (the blocked matmul/softmax kernels). TPU-native
+design: the layout is STATIC (a [num_q_blocks, num_kv_blocks] bool matrix), so
+each query block gathers only its active key/value blocks — compute and memory
+scale with ``nnz_blocks``, not T² — and XLA tiles the gathered einsums onto the
+MXU without a custom kernel. Per-row active lists are padded to the densest
+row (static shapes; the pad is masked).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# layouts (SparsityConfig parity) — plain numpy, computed once per shape
+# ---------------------------------------------------------------------------
+
+def fixed_layout(num_blocks: int, num_local_blocks: int = 4,
+                 num_global_blocks: int = 1) -> np.ndarray:
+    """Fixed pattern: local band + leading global blocks (FixedSparsityConfig)."""
+    lay = np.zeros((num_blocks, num_blocks), bool)
+    for i in range(num_blocks):
+        lo = max(0, i - num_local_blocks + 1)
+        lay[i, lo:i + 1] = True
+    lay[:, :num_global_blocks] = True
+    lay[:num_global_blocks, :] = True
+    return lay
+
+
+def bigbird_layout(num_blocks: int, num_sliding_window_blocks: int = 3,
+                   num_global_blocks: int = 1, num_random_blocks: int = 1,
+                   seed: int = 0) -> np.ndarray:
+    """BigBird: sliding window + global + random (BigBirdSparsityConfig)."""
+    lay = np.zeros((num_blocks, num_blocks), bool)
+    half = num_sliding_window_blocks // 2
+    rng = np.random.default_rng(seed)
+    for i in range(num_blocks):
+        lay[i, max(0, i - half):min(num_blocks, i + half + 1)] = True
+        if num_random_blocks and num_blocks > 1:
+            lay[i, rng.choice(num_blocks, size=min(num_random_blocks,
+                                                   num_blocks), replace=False)] = True
+    lay[:, :num_global_blocks] = True
+    lay[:num_global_blocks, :] = True
+    return lay
+
+
+def longformer_layout(num_blocks: int, num_sliding_window_blocks: int = 3,
+                      global_block_indices: Sequence[int] = (0,)) -> np.ndarray:
+    """Longformer: sliding window + chosen global blocks (BSLongformer)."""
+    lay = np.zeros((num_blocks, num_blocks), bool)
+    half = num_sliding_window_blocks // 2
+    for i in range(num_blocks):
+        lay[i, max(0, i - half):min(num_blocks, i + half + 1)] = True
+    for g in global_block_indices:
+        lay[:, g] = True
+        lay[g, :] = True
+    return lay
+
+
+# ---------------------------------------------------------------------------
+# the attention op
+# ---------------------------------------------------------------------------
+
+def _sparse_rows_attend(qb, kb, vb, kv_idx, active, block, causal, row_ids):
+    """Gathered-block attention for a subset of query-block rows.
+
+    qb [B, nr, block, H, d]; kb/vb [B, nr, ma, block, H, d];
+    kv_idx/active [nr, ma]; row_ids [nr] (global q-block index of each row).
+    Pad/causal masks are built on-device from iotas — only the tiny gather
+    tables are baked into the program as constants."""
+    B, nr, _, H, d = qb.shape
+    ma = kv_idx.shape[1]
+    scores = jnp.einsum("bqthd,bqmshd->bhqtms", qb, kb,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    kvi = jnp.asarray(kv_idx)                       # [nr, ma]
+    act = jnp.asarray(active)
+    t_io = jax.lax.broadcasted_iota(jnp.int32, (nr, block, ma, block), 1)
+    s_io = jax.lax.broadcasted_iota(jnp.int32, (nr, block, ma, block), 3)
+    qpos = jnp.asarray(row_ids)[:, None, None, None] * block + t_io
+    kpos = kvi[:, None, :, None] * block + s_io
+    mask = act[:, None, :, None]
+    if causal:
+        mask = mask & (kpos <= qpos)
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    flat = scores.reshape(B, H, nr, block, ma * block)
+    probs = jax.nn.softmax(flat, axis=-1).astype(qb.dtype)
+    probs = probs.reshape(B, H, nr, block, ma, block)
+    return jnp.einsum("bhqtms,bqmshd->bqthd", probs, vb)
+
+
+def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           layout: np.ndarray, block: int = 64,
+                           causal: bool = True) -> jax.Array:
+    """q/k/v ``[B, T, H, d]`` (GQA: k/v heads may divide q heads), ``layout``
+    bool ``[T/block, T/block]``. Returns ``[B, T, H, d]``.
+
+    Sparse rows gather only their active kv blocks (padded to the densest
+    SPARSE row); fully-dense rows (the global query blocks of BigBird /
+    Longformer layouts) are split out and computed with ordinary dense
+    attention so they don't inflate the sparse rows' padding to T².
+    """
+    from deepspeed_tpu.models.transformer import repeat_kv
+
+    B, T, H, d = q.shape
+    k, v = repeat_kv(k, v, H)
+    assert T % block == 0, f"seq {T} not divisible by block {block}"
+    nb = T // block
+    lay = np.asarray(layout, bool).copy()
+    assert lay.shape == (nb, nb), (lay.shape, nb)
+    if causal:
+        lay &= np.tril(np.ones((nb, nb), bool))  # drop fully-future blocks
+    counts = lay.sum(1)
+    dense_rows = np.nonzero(counts == nb)[0]      # global (all-kv) query rows
+    sparse_rows = np.nonzero(counts < nb)[0]
+
+    qb = q.reshape(B, nb, block, H, d)
+    kb = k.reshape(B, nb, block, H, d)
+    vb = v.reshape(B, nb, block, H, d)
+    out = jnp.zeros((B, nb, block, H, d), q.dtype)
+
+    if len(sparse_rows):
+        ma = max(int(counts[sparse_rows].max()), 1)
+        kv_idx = np.zeros((len(sparse_rows), ma), np.int32)
+        active = np.zeros((len(sparse_rows), ma), bool)
+        for j, i in enumerate(sparse_rows):
+            cols = np.nonzero(lay[i])[0]
+            kv_idx[j, :len(cols)] = cols
+            active[j, :len(cols)] = True
+        o = _sparse_rows_attend(qb[:, sparse_rows], kb[:, kv_idx],
+                                vb[:, kv_idx], kv_idx, active, block, causal,
+                                sparse_rows)
+        out = out.at[:, sparse_rows].set(o)
+    if len(dense_rows):
+        # dense rows attend everything: plain attention on their positions
+        qd = qb[:, dense_rows].reshape(B, -1, H, d)
+        s = jnp.einsum("bthd,bshd->bhts", qd, k,
+                       preferred_element_type=jnp.float32) / math.sqrt(d)
+        if causal:
+            qpos = (np.asarray(dense_rows)[:, None] * block
+                    + np.arange(block)[None, :]).reshape(-1)
+            m = jnp.asarray(qpos)[:, None] >= jnp.arange(T)[None, :]
+            s = jnp.where(m[None, None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        od = jnp.einsum("bhts,bshd->bthd", p, v)
+        out = out.at[:, dense_rows].set(
+            od.reshape(B, len(dense_rows), block, H, d))
+    return out.reshape(B, T, H, d)
+
+
+def make_sparse_attention_impl(layout_fn=fixed_layout, block: int = 64, **kw):
+    """Build an attention impl for the model registry: the layout is computed
+    per sequence length on first trace (static thereafter)."""
+    def impl(q, kk, vv, *, causal=True, segment_ids=None):
+        if segment_ids is not None:
+            raise NotImplementedError("sparse attention: no segment_ids")
+        nb = q.shape[1] // block
+        lay = layout_fn(nb, **kw)
+        return block_sparse_attention(q, kk, vv, lay, block=block,
+                                      causal=causal)
+
+    return impl

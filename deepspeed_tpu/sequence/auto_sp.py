@@ -1,0 +1,69 @@
+"""AutoSP: one-call sequence parallelism.
+
+Parity target: ``deepspeed/sequence/auto_sp.py`` ``auto_wrap_model_for_sp`` —
+the reference scans a torch model and injects DistributedAttention where it
+can. Here models are config-driven, so AutoSP reduces to: pick an sp degree
+and the right attention impl for this (seq_len, mesh, head-count) and return a
+model wired for it — no module surgery.
+
+Selection policy:
+  * sp divides the device budget and keeps >= ``tokens_per_shard`` tokens per
+    shard (below that the a2a/ring latency beats the memory win);
+  * ``ulysses`` (two all-to-alls, cheapest) when sp divides both head counts,
+    else ``ring`` (head-count-free, required for GQA with few kv heads);
+  * sp=1 → the dense path untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def suggest_sp(seq_len: int, max_sp: int, num_heads: int,
+               num_kv_heads: Optional[int] = None,
+               tokens_per_shard: int = 4096) -> Tuple[int, str]:
+    """→ (sp degree, attention impl name)."""
+    num_kv_heads = num_kv_heads or num_heads
+    sp = 1
+    d = max_sp
+    while d > 1:
+        if max_sp % d == 0 and seq_len % d == 0 \
+                and seq_len // d >= tokens_per_shard:
+            sp = d
+            break
+        d -= 1
+    if sp == 1:
+        return 1, "auto"
+    impl = ("ulysses" if num_heads % sp == 0 and num_kv_heads % sp == 0
+            else "ring")
+    return sp, impl
+
+
+def auto_wrap_model_for_sp(model, seq_len: int, max_sp: int,
+                           tokens_per_shard: int = 4096):
+    """Return (model', mesh_axes) with the attention impl set for the chosen
+    sp degree (reference ``auto_wrap_model_for_sp``; config swap instead of
+    module injection). ``mesh_axes`` is the ``{"sp": n}`` fragment to merge
+    into the engine mesh config."""
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    cfg = model.cfg
+    if cfg.attention_impl not in ("auto", "xla", "flash"):
+        # a custom impl (sparse, ring, ...) is a semantic choice — silently
+        # swapping it for ulysses/ring would change the computed function
+        raise ValueError(
+            f"AutoSP cannot override attention_impl='{cfg.attention_impl}'; "
+            "configure sequence parallelism manually for custom attention")
+    sp, impl = suggest_sp(seq_len, max_sp, cfg.num_heads, cfg.num_kv_heads,
+                          tokens_per_shard)
+    if sp == 1:
+        log_dist(f"AutoSP: seq_len={seq_len} fits without sequence "
+                 f"parallelism (tokens_per_shard={tokens_per_shard})")
+        return model, {}
+    new_cfg = dataclasses.replace(cfg, attention_impl=impl)
+    log_dist(f"AutoSP: sp={sp} impl={impl} for seq_len={seq_len} "
+             f"(heads={cfg.num_heads}/{cfg.num_kv_heads})")
+    return TransformerLM(new_cfg, moe_fn=model.moe_fn), {"sp": sp}

@@ -1,0 +1,129 @@
+"""Block-sparse attention + AutoSP tests (analogs of the reference's
+``tests/unit/ops/sparse_attention`` parity tests and sequence/test_autosp)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.ops.sparse_attention import (bigbird_layout,
+                                                block_sparse_attention,
+                                                fixed_layout, longformer_layout,
+                                                make_sparse_attention_impl)
+from deepspeed_tpu.sequence.auto_sp import auto_wrap_model_for_sp, suggest_sp
+
+
+def _qkv(T=256, H=4, K=4, d=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return (jax.random.normal(ks[0], (2, T, H, d)),
+            jax.random.normal(ks[1], (2, T, K, d)),
+            jax.random.normal(ks[2], (2, T, K, d)))
+
+
+def _dense_masked(q, k, v, lay, block, causal):
+    """Dense reference with the same block mask at element level."""
+    import jax.numpy as jnp
+    import math
+
+    from deepspeed_tpu.models.transformer import repeat_kv
+
+    k, v = repeat_kv(k, v, q.shape[2])
+    T = q.shape[1]
+    elem = np.kron(np.asarray(lay, bool), np.ones((block, block), bool))
+    if causal:
+        elem &= np.tril(np.ones((T, T), bool))
+    s = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(q.shape[-1])
+    s = jnp.where(jnp.asarray(elem)[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+@pytest.mark.parametrize("layout_fn,kw", [
+    (fixed_layout, {"num_local_blocks": 2, "num_global_blocks": 1}),
+    (bigbird_layout, {"num_sliding_window_blocks": 3, "num_global_blocks": 1,
+                      "num_random_blocks": 1}),
+    (longformer_layout, {"num_sliding_window_blocks": 3,
+                         "global_block_indices": (0, 2)}),
+])
+@pytest.mark.parametrize("causal", [True, False])
+def test_block_sparse_matches_dense_masked(layout_fn, kw, causal):
+    q, k, v = _qkv(T=256)
+    lay = layout_fn(4, **kw)
+    got = block_sparse_attention(q, k, v, lay, block=64, causal=causal)
+    ref = _dense_masked(q, k, v, lay, 64, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_block_sparse_gqa():
+    q, k, v = _qkv(T=128, H=8, K=2)
+    lay = fixed_layout(2, num_local_blocks=1)
+    got = block_sparse_attention(q, k, v, lay, block=64)
+    ref = _dense_masked(q, k, v, lay, 64, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_sparse_impl_in_model_registry(eight_devices):
+    """The registry impl runs a model forward end to end."""
+    from deepspeed_tpu.models import TransformerLM, TransformerConfig
+    from deepspeed_tpu.models.transformer import register_attention_impl
+
+    register_attention_impl("sparse_fixed", make_sparse_attention_impl(
+        fixed_layout, block=32, num_local_blocks=2))
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=128,
+                            attention_impl="sparse_fixed")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    loss = model.loss_fn(params, {"input_ids": np.random.default_rng(0)
+                                  .integers(0, 128, (2, 128))})
+    assert np.isfinite(float(loss))
+
+
+def test_layouts_shapes():
+    assert fixed_layout(8, 2, 1).sum() > 8  # band + globals
+    bb = bigbird_layout(8, 3, 1, 1, seed=0)
+    assert bb[:, 0].all() and bb[0].all()   # global row/col
+    lf = longformer_layout(8, 3, (0, 4))
+    assert lf[:, 4].all() and lf[4].all()
+
+
+def test_suggest_sp_policy():
+    # plenty of tokens: take the biggest divisor with heads compatible
+    assert suggest_sp(65536, 8, 16, 16, tokens_per_shard=4096) == (8, "ulysses")
+    # GQA with 2 kv heads: sp=8 can't do ulysses → ring
+    assert suggest_sp(65536, 8, 16, 2, tokens_per_shard=4096) == (8, "ring")
+    # short sequences: stay dense
+    assert suggest_sp(2048, 8, 16, 16, tokens_per_shard=4096) == (1, "auto")
+
+
+def test_auto_wrap_refuses_custom_impl():
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    model = TransformerLM(dataclasses.replace(get_preset("tiny"),
+                                              attention_impl="ring"))
+    with pytest.raises(ValueError, match="cannot override"):
+        auto_wrap_model_for_sp(model, seq_len=32768, max_sp=8)
+
+
+def test_auto_wrap_model(eight_devices):
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    model = TransformerLM(dataclasses.replace(get_preset("tiny"),
+                                              max_seq_len=32768))
+    m2, mesh = auto_wrap_model_for_sp(model, seq_len=32768, max_sp=8)
+    assert mesh == {"sp": 8}
+    assert m2.cfg.attention_impl in ("ulysses", "ring")
+    # params interchangeable (same shapes/config otherwise)
+    p = model.init(jax.random.key(0))
+    import deepspeed_tpu as ds
+
+    eng, *_ = ds.initialize(model=m2, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"sp": 8, "dp": 1}, "steps_per_print": 100})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (1, 4096))}
+    loss = eng.forward(batch)
+    assert np.isfinite(float(loss))
