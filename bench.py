@@ -40,21 +40,23 @@ def main() -> None:
     if on_tpu:
         # flagship single-chip config tuned for v5e HBM/MXU: d=128 heads (MXU
         # lane-width), dots_and_attn_saveable remat (never recompute the
-        # VPU-bound attention kernel), params cast once per step
+        # VPU-bound attention kernel), params cast once per step, ga=4 so the
+        # in-jit microbatch scan amortizes the optimizer + cast over 4x tokens
+        # (measured: 0.543 -> 0.595 MFU over ga=1; ga>=6 exhausts HBM)
         cfg = TransformerConfig(
             vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
             num_kv_heads=6, max_seq_len=2048, arch="llama",
             remat_policy="dots_and_attn_saveable")
-        batch, seq, steps, warmup = 4, 2048, 10, 2
+        batch, ga, seq, steps, warmup = 4, 4, 2048, 8, 2
     else:  # dev fallback so the harness is runnable anywhere
         cfg = TransformerConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                                 num_heads=4, max_seq_len=256, arch="llama")
-        batch, seq, steps, warmup = 2, 128, 3, 1
+        batch, ga, seq, steps, warmup = 2, 1, 128, 3, 1
 
     model = TransformerLM(cfg)
     config = {
         "train_micro_batch_size_per_gpu": batch,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": ga,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "zero_optimization": {"stage": 0},
         "steps_per_print": 10 ** 9,
@@ -64,18 +66,19 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     def make_batch():
-        return {"input_ids": rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)}
+        return {"input_ids": rng.integers(0, cfg.vocab_size,
+                                          (batch * ga, seq)).astype(np.int32)}
 
     for _ in range(warmup):
         engine.fused_train_step(make_batch()).block_until_ready()
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.fused_train_step(make_batch())
-    loss.block_until_ready()
+    losses = [engine.fused_train_step(make_batch()) for _ in range(steps)]
+    for loss in losses:
+        loss.block_until_ready()
     dt = time.perf_counter() - t0
 
-    tokens_per_step = batch * seq
+    tokens_per_step = batch * ga * seq
     tokens_per_sec = tokens_per_step * steps / dt
 
     # FLOPs/token: 6*N for the dense path + attention score/value term
@@ -95,7 +98,7 @@ def main() -> None:
             "model_params_m": round(n_params / 1e6, 1),
             "loss": round(float(loss), 4),
             "device": getattr(dev, "device_kind", str(dev)),
-            "batch": batch, "seq": seq, "steps": steps,
+            "batch": batch, "ga": ga, "seq": seq, "steps": steps,
         },
     }
     print(json.dumps(result))

@@ -21,7 +21,7 @@ import jax
 POLICIES = (
     "none", "full", "dots_saveable", "nothing_saveable",
     "dots_with_no_batch_dims_saveable", "attn_saveable",
-    "dots_and_attn_saveable", "offload_dots",
+    "dots_and_attn_saveable", "offload_dots", "offload_attn",
 )
 
 #: the checkpoint_name tag attached by ops/flash_attention.py (and the XLA
@@ -47,10 +47,21 @@ def resolve_policy(policy: str):
         # call in the backward; pin its named output as well
         return cp.save_from_both_policies(
             cp.dots_saveable, cp.save_only_these_names(ATTN_CHECKPOINT_NAME))
-    if policy == "offload_dots":
+    if policy == "offload_attn":
+        # the FPDT/Ulysses-Offload memory tier (sequence/fpdt_layer.py:545):
+        # attention outputs live in HOST memory between forward and backward,
+        # freeing HBM ∝ L·B·T·D for long-context training; XLA schedules the
+        # D2H/H2D copies asynchronously around the remat boundaries
         return cp.save_and_offload_only_these_names(
-            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[ATTN_CHECKPOINT_NAME],
             offload_src="device", offload_dst="pinned_host")
+    if policy == "offload_dots":
+        if hasattr(cp, "offload_dot_with_no_batch_dims"):
+            return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+        # older JAX: no dot-offload policy — the named-attention offload is
+        # the closest available behavior (== offload_attn)
+        return resolve_policy("offload_attn")
     if policy not in POLICIES:
         raise ValueError(f"unknown remat policy '{policy}' "
                          f"(have {sorted(POLICIES)})")
