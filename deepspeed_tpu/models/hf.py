@@ -176,12 +176,8 @@ def load_hf_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
             "this architecture is not fully supported")
     import jax
 
-    if moe:
-        from deepspeed_tpu.moe import moe_mlp_block
-
-        model = TransformerLM(cfg, moe_fn=moe_mlp_block)
-    else:
-        model = TransformerLM(cfg)
+    # TransformerLM derives the MoE dispatch from cfg.moe_dispatch itself
+    model = TransformerLM(cfg)
     n = sum(a.size for a in jax.tree_util.tree_leaves(params))
     log_dist(f"imported HF checkpoint {path}: {hf_cfg.get('model_type')} "
              f"{n/1e6:.1f}M params, L={L}")

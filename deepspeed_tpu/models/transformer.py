@@ -67,6 +67,9 @@ class TransformerConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # "capacity" (GShard einsum, the EP form) | "grouped" (dropless
+    # ragged_dot grouped GEMM — single expert shard)
+    moe_dispatch: str = "capacity"
 
     def __post_init__(self):
         is_llama = self.arch == "llama"
@@ -331,6 +334,12 @@ class TransformerLM:
 
     def __init__(self, cfg: TransformerConfig, moe_fn: Optional[Callable] = None):
         self.cfg = cfg
+        if moe_fn is None and cfg.num_experts > 1:
+            # derive the dispatch algebra from cfg.moe_dispatch so every
+            # construction path (direct, HF import, presets) honors it
+            from deepspeed_tpu.moe import moe_block_for
+
+            moe_fn = moe_block_for(cfg)
         self.moe_fn = moe_fn
         self._freqs = (rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                         cfg.rope_theta, cfg.rope_scaling)

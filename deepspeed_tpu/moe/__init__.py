@@ -8,5 +8,6 @@ constraints, so XLA SPMD emits the same all-to-alls the reference issues manuall
 """
 
 from deepspeed_tpu.moe.sharded_moe import (  # noqa: F401
-    MoE, moe_mlp_block, top1_gating, topk_gating,
+    MoE, grouped_moe_mlp_block, moe_block_for, moe_mlp_block, top1_gating,
+    topk_gating,
 )

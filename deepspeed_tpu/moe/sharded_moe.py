@@ -30,6 +30,24 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
     return max(cap, min_capacity)
 
 
+def _route(logits: jax.Array, k: int, rng: Optional[jax.Array] = None,
+           noise_std: float = 0.0):
+    """Shared router prefix for BOTH dispatch algebras: fp32 gates, GShard
+    top-1 aux loss (sharded_moe.py:184 l_aux), renormalized top-k weights."""
+    E = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if noise_std > 0.0 and rng is not None:  # noisy_gate_policy='RSample' parity
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+    gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
+    top1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(top1, E, dtype=jnp.float32)
+    aux_loss = jnp.sum(jnp.mean(gates, axis=0) * jnp.mean(mask1, axis=0)) * E
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
+    # renormalize the kept gate mass (reference normalizes combine weights)
+    topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
+    return gates, aux_loss, topk_vals, topk_idx
+
+
 def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
                 min_capacity: int = 4, rng: Optional[jax.Array] = None,
                 noise_std: float = 0.0
@@ -43,22 +61,7 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
     """
     S, E = logits.shape
     C = _capacity(S, E, capacity_factor, min_capacity)
-    logits = logits.astype(jnp.float32)
-    if noise_std > 0.0 and rng is not None:  # noisy_gate_policy='RSample' parity
-        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
-    gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
-
-    # aux load-balancing loss on the top-1 assignment (sharded_moe.py:184 l_aux)
-    top1 = jnp.argmax(gates, axis=-1)
-    mask1 = jax.nn.one_hot(top1, E, dtype=jnp.float32)
-    me = jnp.mean(gates, axis=0)
-    ce = jnp.mean(mask1, axis=0)
-    aux_loss = jnp.sum(me * ce) * E
-
-    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
-    # renormalize the kept gate mass (reference normalizes combine weights)
-    denom = jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
-    topk_vals = topk_vals / denom
+    _gates, aux_loss, topk_vals, topk_idx = _route(logits, k, rng, noise_std)
 
     dispatch = jnp.zeros((S, E, C), jnp.float32)
     combine = jnp.zeros((S, E, C), jnp.float32)
@@ -113,6 +116,59 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
     ye = constrain(ye, P("ep", None, None))
     y = jnp.einsum("sec,ecd->sd", combine.astype(dt), ye)
     return y.reshape(B, T, D), aux
+
+
+def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Dropless sort-based dispatch over grouped GEMMs — the
+    ``inference/v2/kernels/cutlass_ops/moe_gemm`` (MegaBlocks-style) analog,
+    expressed with ``jax.lax.ragged_dot`` so XLA emits the grouped matmul.
+
+    Unlike the capacity path, every (token, expert) pair is computed — no
+    ``capacity_factor`` padding waste and no dropped tokens — at the price of
+    data-dependent group sizes (static TOTAL shape ``S*k``, so it still jits).
+    Single-shard experts only: under ``ep > 1`` the grouped contraction cannot
+    be partitioned over the expert axis — the capacity einsum path is the EP
+    form (use ``moe_dispatch="capacity"``).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if (mesh is not None and not mesh.empty and "ep" in mesh.axis_names
+            and mesh.shape["ep"] > 1):
+        raise ValueError("grouped MoE dispatch does not partition over ep>1; "
+                         "use moe_dispatch='capacity' for expert parallelism")
+    B, T, D = h.shape
+    E = w["router"].shape[-1]
+    k = cfg.top_k
+    x = h.reshape(B * T, D)
+    S = x.shape[0]
+    logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)
+    _gates, aux_loss, topk_vals, topk_idx = _route(logits, k)
+
+    flat_expert = topk_idx.reshape(-1)                        # [S*k]
+    order = jnp.argsort(flat_expert)                          # group by expert
+    tok = order // k
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    dt = h.dtype
+    xs = x[tok].astype(dt)                                    # [S*k, D]
+    if "w_gate" in w:
+        act = jax.nn.silu(jax.lax.ragged_dot(xs, w["w_gate"].astype(dt),
+                                             group_sizes))
+        act = act * jax.lax.ragged_dot(xs, w["w_up"].astype(dt), group_sizes)
+    else:
+        act = jax.nn.gelu(jax.lax.ragged_dot(xs, w["w_up"].astype(dt),
+                                             group_sizes), approximate=True)
+    ys = jax.lax.ragged_dot(act, w["w_down"].astype(dt), group_sizes)  # [S*k, D]
+    weights = topk_vals.reshape(-1)[order].astype(dt)
+    out = jnp.zeros((S, D), dt).at[tok].add(ys * weights[:, None])
+    return out.reshape(B, T, D), aux_loss
+
+
+def moe_block_for(cfg: Any):
+    """Select the dispatch algebra from ``cfg.moe_dispatch``."""
+    if getattr(cfg, "moe_dispatch", "capacity") == "grouped":
+        return grouped_moe_mlp_block
+    return moe_mlp_block
 
 
 class MoE:

@@ -70,3 +70,107 @@ def test_moe_ep_training(eight_devices):
         eng.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+class TestGroupedDispatch:
+    def test_grouped_matches_capacity_when_no_drops(self, eight_devices):
+        """With capacity high enough that nothing drops, the grouped
+        (ragged_dot) path computes the same function as the capacity einsum."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.moe import grouped_moe_mlp_block, moe_mlp_block
+
+        class Cfg:
+            top_k = 2
+            capacity_factor = 8.0  # no drops
+            min_capacity = 4
+
+        rng = jax.random.split(jax.random.key(0), 5)
+        D, F, E = 16, 32, 4
+        w = {"router": jax.random.normal(rng[0], (D, E)) * 0.1,
+             "w_gate": jax.random.normal(rng[1], (E, D, F)) / 4,
+             "w_up": jax.random.normal(rng[2], (E, D, F)) / 4,
+             "w_down": jax.random.normal(rng[3], (E, F, D)) / 6}
+        h = jax.random.normal(rng[4], (2, 16, D))
+        yc, auxc = moe_mlp_block(h, w, Cfg())
+        yg, auxg = jax.jit(grouped_moe_mlp_block, static_argnums=2)(h, w, Cfg())
+        np.testing.assert_allclose(np.asarray(yg), np.asarray(yc),
+                                   rtol=2e-3, atol=2e-5)
+        np.testing.assert_allclose(float(auxg), float(auxc), rtol=1e-5)
+
+    def test_grouped_is_dropless(self, eight_devices):
+        """At a starvation capacity the einsum path drops tokens; the grouped
+        path computes all of them (the cutlass moe_gemm property)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.moe import grouped_moe_mlp_block, moe_mlp_block
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        class Tight:
+            top_k = 2
+            capacity_factor = 0.1
+            min_capacity = 1
+
+        rng = jax.random.split(jax.random.key(1), 5)
+        D, F, E = 16, 32, 4
+        w = {"router": jax.random.normal(rng[0], (D, E)) * 0.1,
+             "w_gate": jax.random.normal(rng[1], (E, D, F)) / 4,
+             "w_up": jax.random.normal(rng[2], (E, D, F)) / 4,
+             "w_down": jax.random.normal(rng[3], (E, F, D)) / 6}
+        h = jax.random.normal(rng[4], (1, 64, D))
+        x = np.asarray(h.reshape(-1, D))
+        logits = jnp.asarray(x) @ w["router"]
+        _, _, _, stats = topk_gating(logits, k=2, capacity_factor=0.1,
+                                     min_capacity=1)
+        assert float(stats["drop_fraction"]) > 0.1  # einsum path drops
+        yg, _ = grouped_moe_mlp_block(h, w, Tight())
+        # every token got its full top-2 contribution: output differs from the
+        # dropping path and is finite everywhere
+        yc, _ = moe_mlp_block(h, w, Tight())
+        assert np.isfinite(np.asarray(yg)).all()
+        assert not np.allclose(np.asarray(yg), np.asarray(yc))
+
+    def test_grouped_trains(self, eight_devices):
+        """End to end under the engine with moe_dispatch='grouped'."""
+        import dataclasses
+
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import TransformerLM, get_preset
+        from deepspeed_tpu.moe import moe_block_for
+
+        cfg = dataclasses.replace(get_preset("tiny-moe"),
+                                  moe_dispatch="grouped")
+        model = TransformerLM(cfg, moe_fn=moe_block_for(cfg))
+        eng, *_ = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+            "steps_per_print": 100})
+        b = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 32))}
+        losses = []
+        for _ in range(4):
+            loss = eng.forward(b)
+            eng.backward(loss)
+            eng.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_grouped_rejects_ep(self, eight_devices):
+        import dataclasses
+
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import TransformerLM, get_preset
+        from deepspeed_tpu.moe import moe_block_for
+
+        cfg = dataclasses.replace(get_preset("tiny-moe"),
+                                  moe_dispatch="grouped")
+        model = TransformerLM(cfg, moe_fn=moe_block_for(cfg))
+        with pytest.raises(Exception, match="ep"):
+            eng, *_ = ds.initialize(model=model, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}, "mesh": {"ep": 4, "dp": 2},
+                "steps_per_print": 100})
+            eng.forward({"input_ids": np.zeros((4, 32), np.int32)})
