@@ -69,6 +69,10 @@ def config_from_hf(hf_cfg: Any, **overrides) -> TransformerConfig:
     if model_type == "mixtral":
         kw["num_experts"] = get("num_local_experts")
         kw["top_k"] = get("num_experts_per_tok", 2)
+        # Mixtral routes droplessly with renormalized top-k softmax — exactly
+        # the grouped (ragged_dot) dispatch; the capacity path would drop
+        # overflow tokens and diverge from transformers
+        kw["moe_dispatch"] = "grouped"
     kw.update(overrides)
     return TransformerConfig(**kw)
 

@@ -166,8 +166,12 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
 
 def moe_block_for(cfg: Any):
     """Select the dispatch algebra from ``cfg.moe_dispatch``."""
-    if getattr(cfg, "moe_dispatch", "capacity") == "grouped":
+    dispatch = getattr(cfg, "moe_dispatch", "capacity")
+    if dispatch == "grouped":
         return grouped_moe_mlp_block
+    if dispatch != "capacity":
+        raise ValueError(f"unknown moe_dispatch '{dispatch}' "
+                         "(have: capacity, grouped)")
     return moe_mlp_block
 
 

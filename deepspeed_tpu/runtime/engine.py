@@ -68,6 +68,25 @@ class DeepSpeedTpuEngine:
         self.fp16_enabled = bool(config.fp16.enabled)
         self.bf16_enabled = bool(config.bf16.enabled) and not self.fp16_enabled
 
+        # MiCS (mics_config.py parity): params sharded over a SUB-group with
+        # replication across groups. On a named mesh that IS the layout
+        # {"fsdp": mics_shard_size, "dp": world/mics_shard_size} — validate
+        # the mesh agrees rather than silently ignoring the key.
+        mics = int(config.zero_optimization.mics_shard_size)
+        if mics > 0:
+            fsdp = self.topology.axis_sizes.get("fsdp", 1)
+            if fsdp != mics:
+                raise ValueError(
+                    f"mics_shard_size={mics} but the mesh fsdp axis is {fsdp}"
+                    " — MiCS on a named mesh IS {'fsdp': mics_shard_size, "
+                    "'dp': world // mics_shard_size}; set the mesh to match")
+            if (config.zero_optimization.mics_hierarchical_params_gather
+                    and config.zero_optimization.zero_hpz_partition_size <= 1):
+                raise ValueError(
+                    "mics_hierarchical_params_gather needs "
+                    "zero_hpz_partition_size > 1 (the hierarchical gather is "
+                    "the hpZ secondary partition)")
+
         # ---- schedules & optimizer ------------------------------------
         self.lr_scheduler = lr_scheduler
         schedule_fn = None

@@ -134,3 +134,26 @@ def test_zeropp_fused_step_matches_imperative(eight_devices):
     pb = jax.tree_util.tree_leaves(b.params)
     for x, y in zip(pa, pb):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-2)
+
+
+def test_mics_mesh_validation(eight_devices):
+    """MiCS keys are validated against the mesh, not silently ignored."""
+    ok = ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "mics_shard_size": 4},
+        "mesh": {"fsdp": 4, "dp": 2}, "steps_per_print": 100})[0]
+    assert ok.topology.size("fsdp") == 4
+    with pytest.raises(ValueError, match="mics_shard_size"):
+        ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "mics_shard_size": 4},
+            "mesh": {"fsdp": 8}, "steps_per_print": 100})
+    with pytest.raises(ValueError, match="hierarchical"):
+        ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "mics_shard_size": 4,
+                                  "mics_hierarchical_params_gather": True},
+            "mesh": {"fsdp": 4, "dp": 2}, "steps_per_print": 100})
