@@ -1,0 +1,101 @@
+"""Evoformer (DS4Science) fused attention.
+
+Parity target: ``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+``DS4Sci_EvoformerAttention`` + ``csrc/evoformer_attn`` — attention over MSA /
+pair activations with up to two broadcast biases:
+
+    O = softmax(Q Kᵀ / sqrt(d) + bias1 + bias2) V
+
+with Q/K/V ``[B, N, L, H, D]``, ``bias1 [B, N, 1, 1, L]`` (per-row mask bias)
+and ``bias2 [B, 1, H, L, L]`` (pair bias). The CUDA kernel exists to avoid
+materializing the [.., H, L, L] score tensor; here a ``lax.scan`` over query
+chunks keeps peak memory at ``chunk × L`` per (batch, head) while XLA fuses
+the bias adds into the matmul epilogue — autodiff provides the backward
+(including bias gradients) for free.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _attend_chunk(qc, k, v, b1, b2c, scale):
+    # qc [.., C, H, D]; k/v [.., L, H, D]; b1 [.., 1, 1, L]; b2c [.., H, C, L]
+    s = jnp.einsum("...qhd,...khd->...hqk", qc, k,
+                   preferred_element_type=jnp.float32) * scale
+    if b1 is not None:
+        s = s + b1.astype(jnp.float32)          # broadcasts over heads+q
+    if b2c is not None:
+        s = s + b2c.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(qc.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", p, v)
+
+
+def DS4Sci_EvoformerAttention(Q: jax.Array, K: jax.Array, V: jax.Array,
+                              biases: Sequence[Optional[jax.Array]],
+                              chunk_size: int = 256) -> jax.Array:
+    """Reference-shaped entry point (evoformer_attn.py:88).
+
+    ``Q/K/V``: ``[*, L, H, D]`` (typically ``[B, N, L, H, D]``); ``biases`` a
+    list of up to two: bias1 ``[B, N, 1, 1, L]``, bias2 ``[B, 1, H, L, L]``.
+    """
+    biases = list(biases)
+    assert len(biases) <= 2, "at most two biases (mask bias, pair bias)"
+    while len(biases) < 2:
+        biases.append(None)
+    b1, b2 = biases[0], biases[1]
+    if b1 is not None:
+        want = Q.shape[:-3] + (1, 1, Q.shape[-3])
+        assert b1.shape == want, f"bias1 shape {b1.shape} != {want}"
+    if b2 is not None:
+        assert Q.ndim == 5, ("bias2 requires the [B, N, L, H, D] layout — a "
+                             "rank-4 Q would broadcast across batches")
+        want = (Q.shape[0], 1, Q.shape[-2], Q.shape[-3], Q.shape[-3])
+        assert b2.shape == want, f"bias2 shape {b2.shape} != {want}"
+    L = Q.shape[-3]
+    scale = 1.0 / math.sqrt(Q.shape[-1])
+    if L <= chunk_size:
+        return _attend_chunk(Q, K, V, b1, b2, scale)
+
+    # pad queries to a chunk multiple so EVERY length takes the scan path
+    # (the memory guarantee must not silently lapse for odd lengths)
+    pad = (-L) % chunk_size
+    if pad:
+        qpad = [(0, 0)] * Q.ndim
+        qpad[-3] = (0, pad)
+        Qp = jnp.pad(Q, qpad)
+        b2p = None
+        if b2 is not None:
+            b2p = jnp.pad(b2, [(0, 0)] * (b2.ndim - 2) + [(0, pad), (0, 0)])
+        out = _chunked(Qp, K, V, b1, b2p, scale, chunk_size)
+        return jax.lax.slice_in_dim(out, 0, L, axis=out.ndim - 3)
+    return _chunked(Q, K, V, b1, b2, scale, chunk_size)
+
+
+def _chunked(Q, K, V, b1, b2, scale, chunk_size):
+    """Scan over query chunks; K/V/b1 are loop-invariant. Q's query length may
+    exceed K's (padded queries) — b2's key dim follows K."""
+    Lq = Q.shape[-3]
+    Lk = K.shape[-3]
+    nc = Lq // chunk_size
+    q_chunks = jnp.moveaxis(
+        Q.reshape(Q.shape[:-3] + (nc, chunk_size) + Q.shape[-2:]), -4, 0)
+    if b2 is not None:
+        b2_chunks = jnp.moveaxis(
+            b2.reshape(b2.shape[:-2] + (nc, chunk_size, Lk)), -3, 0)
+    else:
+        b2_chunks = jnp.zeros((nc,), jnp.float32)  # dummy xs
+
+    def step(carry, xs):
+        qc, b2c = xs
+        o = _attend_chunk(qc, K, V, b1,
+                          None if b2 is None else b2c, scale)
+        return carry, o
+
+    _, outs = jax.lax.scan(step, None, (q_chunks, b2_chunks))
+    out = jnp.moveaxis(outs, 0, -4)  # [.., nc, C, H, D]
+    return out.reshape(Q.shape)
