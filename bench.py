@@ -41,13 +41,15 @@ def main() -> None:
         # flagship single-chip config tuned for v5e HBM/MXU: d=128 heads (MXU
         # lane-width), dots_and_attn_saveable remat (never recompute the
         # VPU-bound attention kernel), params cast once per step, ga=4 so the
-        # in-jit microbatch scan amortizes the optimizer + cast over 4x tokens
-        # (measured: 0.543 -> 0.595 MFU over ga=1; ga>=6 exhausts HBM)
+        # in-jit microbatch scan amortizes the optimizer + cast over 4x tokens.
+        # seq 8192 = Llama-3's native context (the BASELINE.md 8B north-star);
+        # measured MFU ladder: 0.543 (b4 s2048 ga1) -> 0.600 (ga4) -> 0.634
+        # (s8192 b1 ga4)
         cfg = TransformerConfig(
             vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
-            num_kv_heads=6, max_seq_len=2048, arch="llama",
+            num_kv_heads=6, max_seq_len=8192, arch="llama",
             remat_policy="dots_and_attn_saveable")
-        batch, ga, seq, steps, warmup = 4, 4, 2048, 8, 2
+        batch, ga, seq, steps, warmup = 1, 4, 8192, 8, 2
     else:  # dev fallback so the harness is runnable anywhere
         cfg = TransformerConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                                 num_heads=4, max_seq_len=256, arch="llama")
@@ -70,23 +72,33 @@ def main() -> None:
                                           (batch * ga, seq)).astype(np.int32)}
 
     for _ in range(warmup):
-        engine.fused_train_step(make_batch()).block_until_ready()
+        # float() = real device->host fetch: on tunneled runtimes
+        # block_until_ready alone has been seen to return early, which would
+        # let warmup work bleed into (and inflate) the timed window
+        float(engine.fused_train_step(make_batch()))
 
-    t0 = time.perf_counter()
-    losses = [engine.fused_train_step(make_batch()) for _ in range(steps)]
-    for loss in losses:
-        loss.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch * ga * seq
-    tokens_per_sec = tokens_per_step * steps / dt
-
-    # FLOPs/token: 6*N for the dense path + attention score/value term
+    peak = detect_peak(dev)
     n_params = cfg.num_params_estimate()
+    # FLOPs/token: 6*N for the dense path + attention score/value term
     attn_flops_per_token = 12 * cfg.num_layers * seq * cfg.hidden_size
     flops_per_token = 6 * n_params + attn_flops_per_token
-    achieved = tokens_per_sec * flops_per_token
-    mfu = achieved / detect_peak(dev)
+    tokens_per_step = batch * ga * seq
+
+    def timed_run():
+        t0 = time.perf_counter()
+        losses = [engine.fused_train_step(make_batch()) for _ in range(steps)]
+        vals = [float(l) for l in losses]  # materialize: see warmup note
+        dt = time.perf_counter() - t0
+        tps = tokens_per_step * steps / dt
+        return tps, tps * flops_per_token / peak, vals[-1]
+
+    for attempt in range(3):  # retry physically impossible readings
+        tokens_per_sec, mfu, last_loss = timed_run()
+        if mfu <= 1.0:
+            break
+    else:
+        raise RuntimeError(f"benchmark clock/runtime glitch: measured MFU "
+                           f"{mfu:.2f} > 1.0 on every attempt")
 
     result = {
         "metric": "train_tokens_per_sec_per_chip",
@@ -96,7 +108,7 @@ def main() -> None:
         "extra": {
             "mfu": round(mfu, 4),
             "model_params_m": round(n_params / 1e6, 1),
-            "loss": round(float(loss), 4),
+            "loss": round(last_loss, 4),
             "device": getattr(dev, "device_kind", str(dev)),
             "batch": batch, "ga": ga, "seq": seq, "steps": steps,
         },
