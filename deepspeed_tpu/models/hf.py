@@ -8,8 +8,9 @@ modules, we map an HF safetensors checkpoint into the ``TransformerLM`` param
 tree (stacked-layer layout) once, and infer ``PartitionSpec`` trees for
 arbitrary external pytrees by the same name-pattern table AutoTP uses.
 
-Supported families: Llama/Llama-2/3 (``LlamaForCausalLM``) and Mixtral
-(``MixtralForCausalLM``). Weight-layout notes:
+Supported families (the reference's inference-v2 model_implementations/ set):
+Llama/Llama-2/3, Mistral, Qwen2, Phi-3, Mixtral, Falcon (rotary variants),
+GPT-NeoX/Pythia, GPT-2, OPT. Weight-layout notes:
   * torch ``nn.Linear`` stores ``[out, in]``; our matmuls are ``x @ w`` with
     ``w [in, out]`` → every projection transposes on import.
   * per-layer tensors stack on a leading layer axis (the ``lax.scan`` layout).
@@ -39,40 +40,157 @@ __all__ = ["config_from_hf", "load_hf_checkpoint", "from_pretrained",
            "infer_tp_specs", "TP_PATTERNS"]
 
 
+_LLAMA_FAMILY = ("llama", "mistral", "qwen2", "phi3", "mixtral")
+_SUPPORTED = _LLAMA_FAMILY + ("falcon", "gpt_neox", "gpt2", "opt")
+
+_HF_ACT = {"silu": "swiglu", "gelu": "gelu_exact", "gelu_new": "gelu",
+           "gelu_pytorch_tanh": "gelu", "gelu_fast": "gelu", "relu": "relu"}
+
+
 def config_from_hf(hf_cfg: Any, **overrides) -> TransformerConfig:
     """Map an HF config (object or dict) to :class:`TransformerConfig`."""
     get = (hf_cfg.get if isinstance(hf_cfg, dict)
            else lambda k, d=None: getattr(hf_cfg, k, d))
     model_type = get("model_type", "llama")
-    if model_type not in ("llama", "mixtral"):
+    if model_type not in _SUPPORTED:
         raise ValueError(
-            f"unsupported model_type '{model_type}' — supported: llama, "
-            "mixtral (other families with llama-like names would import "
-            "silently wrong, e.g. qwen2's qkv biases)")
-    rope_scaling = get("rope_scaling")
-    if rope_scaling is not None and not isinstance(rope_scaling, dict):
-        rope_scaling = dict(rope_scaling)
-    kw = dict(
-        vocab_size=get("vocab_size"),
-        hidden_size=get("hidden_size"),
-        num_layers=get("num_hidden_layers"),
-        num_heads=get("num_attention_heads"),
-        num_kv_heads=get("num_key_value_heads") or get("num_attention_heads"),
-        intermediate_size=get("intermediate_size"),
-        max_seq_len=get("max_position_embeddings", 2048),
-        arch="llama",
-        rope_theta=float(get("rope_theta", 10000.0)),
-        rope_scaling=rope_scaling,  # llama3/linear scaling, rope_frequencies
-        norm_eps=float(get("rms_norm_eps", 1e-5)),
-        tie_embeddings=bool(get("tie_word_embeddings", False)),
-    )
-    if model_type == "mixtral":
-        kw["num_experts"] = get("num_local_experts")
-        kw["top_k"] = get("num_experts_per_tok", 2)
-        # Mixtral routes droplessly with renormalized top-k softmax — exactly
-        # the grouped (ragged_dot) dispatch; the capacity path would drop
-        # overflow tokens and diverge from transformers
-        kw["moe_dispatch"] = "grouped"
+            f"unsupported model_type '{model_type}' — supported: "
+            f"{', '.join(_SUPPORTED)} (unknown families would import "
+            "silently wrong)")
+    if model_type in _LLAMA_FAMILY:
+        rope_scaling = get("rope_scaling")
+        if rope_scaling is not None and not isinstance(rope_scaling, dict):
+            rope_scaling = dict(rope_scaling)
+        heads = get("num_attention_heads")
+        hidden = get("hidden_size")
+        hd = get("head_dim")
+        if hd is not None and hd != hidden // heads:
+            raise ValueError(
+                f"head_dim={hd} != hidden_size/num_heads={hidden // heads} — "
+                "decoupled head_dim is not supported")
+        kw = dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=hidden,
+            num_layers=get("num_hidden_layers"),
+            num_heads=heads,
+            num_kv_heads=get("num_key_value_heads") or heads,
+            intermediate_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            arch="llama",
+            rope_theta=float(get("rope_theta", 10000.0)),
+            rope_scaling=rope_scaling,  # llama3/linear scaling, rope_frequencies
+            rope_pct=float(get("partial_rotary_factor") or 1.0),  # phi3
+            norm_eps=float(get("rms_norm_eps", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+        )
+        if model_type == "mixtral":
+            kw["num_experts"] = get("num_local_experts")
+            kw["top_k"] = get("num_experts_per_tok", 2)
+            # Mixtral routes droplessly with renormalized top-k softmax —
+            # exactly the grouped (ragged_dot) dispatch; the capacity path
+            # would drop overflow tokens and diverge from transformers
+            kw["moe_dispatch"] = "grouped"
+        if model_type in ("mistral", "qwen2", "phi3"):
+            win = get("sliding_window")
+            if model_type == "qwen2":
+                if not get("use_sliding_window", False):
+                    win = None
+                elif get("max_window_layers", 0) < kw["num_layers"]:
+                    # HF qwen2 gives the first max_window_layers layers FULL
+                    # attention; our window is global — importing would be
+                    # silently wrong on the mixed-layer checkpoints
+                    raise ValueError(
+                        "qwen2 with use_sliding_window and max_window_layers "
+                        f"< num_hidden_layers ({get('max_window_layers')} < "
+                        f"{kw['num_layers']}) mixes windowed and full layers "
+                        "— not supported")
+            kw["sliding_window"] = win
+        if model_type == "qwen2":
+            kw["qkv_bias"] = True
+    elif model_type == "falcon":
+        if get("alibi", False):
+            raise ValueError("falcon alibi variants are not supported "
+                             "(rotary falcon only)")
+        heads = get("num_attention_heads") or get("n_head")
+        new_arch = bool(get("new_decoder_architecture", False))
+        parallel = bool(get("parallel_attn", True))
+        if new_arch:
+            num_kv = get("num_kv_heads") or heads
+        else:
+            num_kv = 1 if get("multi_query", True) else heads
+        num_ln = get("num_ln_in_parallel_attn") or (2 if new_arch else 1)
+        kw = dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers") or get("n_layer"),
+            num_heads=heads,
+            num_kv_heads=num_kv,
+            intermediate_size=get("ffn_hidden_size") or 4 * get("hidden_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            arch="gpt2", norm="layernorm",
+            activation=_HF_ACT.get(get("activation", "gelu"), "gelu_exact"),
+            use_rope=True, learned_pos=False,
+            rope_theta=float(get("rope_theta", 10000.0)),
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", True)),
+            qkv_bias=bool(get("bias", False)),
+            proj_bias=bool(get("bias", False)),
+            parallel_block=parallel,
+            parallel_shared_norm=parallel and num_ln == 1,
+        )
+    elif model_type == "gpt_neox":
+        kw = dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            intermediate_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            arch="gpt2", norm="layernorm",
+            activation=_HF_ACT.get(get("hidden_act", "gelu"), "gelu_exact"),
+            use_rope=True, learned_pos=False,
+            rope_pct=float(get("rotary_pct", 1.0)),
+            rope_theta=float(get("rope_theta")
+                             or get("rotary_emb_base", 10000.0)),
+            norm_eps=float(get("layer_norm_eps", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+            qkv_bias=True, proj_bias=True,
+            parallel_block=bool(get("use_parallel_residual", True)),
+        )
+    elif model_type == "gpt2":
+        kw = dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("n_embd"),
+            num_layers=get("n_layer"),
+            num_heads=get("n_head"),
+            intermediate_size=get("n_inner") or 4 * get("n_embd"),
+            max_seq_len=get("n_positions", 1024),
+            arch="gpt2",
+            activation=_HF_ACT.get(get("activation_function", "gelu_new"),
+                                   "gelu"),
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True, qkv_bias=True, proj_bias=True,
+        )
+    else:  # opt
+        if not get("do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=False (350m) is "
+                             "not supported (post-norm layout)")
+        if get("word_embed_proj_dim", get("hidden_size")) != get("hidden_size"):
+            raise ValueError("OPT word_embed_proj_dim != hidden_size is not "
+                             "supported")
+        kw = dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            intermediate_size=get("ffn_dim"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            arch="gpt2",
+            activation=_HF_ACT.get(get("activation_function", "relu"), "relu"),
+            norm_eps=1e-5,
+            tie_embeddings=bool(get("tie_word_embeddings", True)),
+            qkv_bias=True, proj_bias=True,
+        )
     kw.update(overrides)
     return TransformerConfig(**kw)
 
@@ -116,46 +234,62 @@ def _stack_experts(sd, layer_fmt: str, L: int, E: int) -> np.ndarray:
         for i in range(L)])
 
 
-def load_hf_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
-                       dtype: str = "float32") -> Tuple[TransformerLM, Any]:
-    """Import an HF Llama/Mixtral checkpoint directory → (model, params).
+def _ln(sd, fmt: str, L: int) -> Dict[str, np.ndarray]:
+    """Stacked layernorm {scale, bias} from ``fmt`` (without .weight/.bias)."""
+    return {"scale": _stack(sd, fmt + ".weight", L),
+            "bias": _stack(sd, fmt + ".bias", L)}
 
-    ``cfg`` overrides the auto-derived config (e.g. to change dtype/remat).
-    """
-    with open(os.path.join(path, "config.json")) as f:
-        hf_cfg = json.load(f)
-    if cfg is None:
-        cfg = config_from_hf(hf_cfg, param_dtype="float32", dtype=dtype)
-    sd = _load_state_dict(path, np.dtype(cfg.param_dtype))
+
+def _build_llama_family(sd, cfg: TransformerConfig, model_type: str):
     L = cfg.num_layers
-    moe = cfg.num_experts > 1
-
-    attn = {
-        "wq": _stack(sd, "model.layers.{}.self_attn.q_proj.weight", L, True),
-        "wk": _stack(sd, "model.layers.{}.self_attn.k_proj.weight", L, True),
-        "wv": _stack(sd, "model.layers.{}.self_attn.v_proj.weight", L, True),
-        "wo": _stack(sd, "model.layers.{}.self_attn.o_proj.weight", L, True),
-    }
-    if moe:
-        E = cfg.num_experts
-        mlp = {
-            "router": _stack(
-                sd, "model.layers.{}.block_sparse_moe.gate.weight", L, True),
-            # mixtral expert naming: w1=gate, w3=up, w2=down
-            "w_gate": _stack_experts(
-                sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w1.weight", L, E),
-            "w_up": _stack_experts(
-                sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w3.weight", L, E),
-            "w_down": _stack_experts(
-                sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w2.weight", L, E),
-        }
+    H, K, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    F = cfg.intermediate_size
+    if model_type == "phi3":
+        # phi3 fuses qkv_proj [(H+2K)*hd, out-major q|k|v] and gate_up [2F]
+        qs, ks, vs, gs, us = [], [], [], [], []
+        for i in range(L):
+            w = sd.pop(f"model.layers.{i}.self_attn.qkv_proj.weight")
+            q, k, v = np.split(w, [H * hd, (H + K) * hd])
+            qs.append(q.T), ks.append(k.T), vs.append(v.T)
+            gu = sd.pop(f"model.layers.{i}.mlp.gate_up_proj.weight")
+            gs.append(gu[:F].T), us.append(gu[F:].T)
+        attn = {"wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+                "wo": _stack(sd, "model.layers.{}.self_attn.o_proj.weight",
+                             L, True)}
+        mlp = {"w_gate": np.stack(gs), "w_up": np.stack(us),
+               "w_down": _stack(sd, "model.layers.{}.mlp.down_proj.weight",
+                                L, True)}
     else:
-        mlp = {
-            "w_gate": _stack(sd, "model.layers.{}.mlp.gate_proj.weight", L, True),
-            "w_up": _stack(sd, "model.layers.{}.mlp.up_proj.weight", L, True),
-            "w_down": _stack(sd, "model.layers.{}.mlp.down_proj.weight", L, True),
+        attn = {
+            "wq": _stack(sd, "model.layers.{}.self_attn.q_proj.weight", L, True),
+            "wk": _stack(sd, "model.layers.{}.self_attn.k_proj.weight", L, True),
+            "wv": _stack(sd, "model.layers.{}.self_attn.v_proj.weight", L, True),
+            "wo": _stack(sd, "model.layers.{}.self_attn.o_proj.weight", L, True),
         }
-    params: Dict[str, Any] = {
+        if cfg.qkv_bias:  # qwen2
+            attn["bq"] = _stack(sd, "model.layers.{}.self_attn.q_proj.bias", L)
+            attn["bk"] = _stack(sd, "model.layers.{}.self_attn.k_proj.bias", L)
+            attn["bv"] = _stack(sd, "model.layers.{}.self_attn.v_proj.bias", L)
+        if cfg.num_experts > 1:
+            E = cfg.num_experts
+            mlp = {
+                "router": _stack(
+                    sd, "model.layers.{}.block_sparse_moe.gate.weight", L, True),
+                # mixtral expert naming: w1=gate, w3=up, w2=down
+                "w_gate": _stack_experts(
+                    sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w1.weight", L, E),
+                "w_up": _stack_experts(
+                    sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w3.weight", L, E),
+                "w_down": _stack_experts(
+                    sd, "model.layers.{0}.block_sparse_moe.experts.{1}.w2.weight", L, E),
+            }
+        else:
+            mlp = {
+                "w_gate": _stack(sd, "model.layers.{}.mlp.gate_proj.weight", L, True),
+                "w_up": _stack(sd, "model.layers.{}.mlp.up_proj.weight", L, True),
+                "w_down": _stack(sd, "model.layers.{}.mlp.down_proj.weight", L, True),
+            }
+    return {
         "embed": {"tokens": sd.pop("model.embed_tokens.weight")},
         "layers": {
             "ln1": {"scale": _stack(
@@ -166,18 +300,200 @@ def load_hf_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
             "mlp": mlp,
         },
         "final_norm": {"scale": sd.pop("model.norm.weight")},
+    }, "lm_head.weight"
+
+
+def _build_falcon(sd, cfg: TransformerConfig, model_type: str):
+    L, D = cfg.num_layers, cfg.hidden_size
+    H, K, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    pre = "transformer.h.{}"
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in range(L):
+        # fused layout: K groups of (H/K q-heads | 1 k | 1 v) rows
+        w = sd.pop(f"transformer.h.{i}.self_attention.query_key_value.weight")
+        w = w.reshape(K, H // K + 2, hd, D)
+        qs.append(w[:, :-2].reshape(H * hd, D).T)
+        ks.append(w[:, -2].reshape(K * hd, D).T)
+        vs.append(w[:, -1].reshape(K * hd, D).T)
+        if cfg.qkv_bias:
+            b = sd.pop(f"transformer.h.{i}.self_attention.query_key_value.bias")
+            b = b.reshape(K, H // K + 2, hd)
+            bqs.append(b[:, :-2].reshape(H * hd))
+            bks.append(b[:, -2].reshape(K * hd))
+            bvs.append(b[:, -1].reshape(K * hd))
+    attn = {"wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "wo": _stack(sd, pre + ".self_attention.dense.weight", L, True)}
+    if cfg.qkv_bias:
+        attn.update(bq=np.stack(bqs), bk=np.stack(bks), bv=np.stack(bvs))
+    if cfg.proj_bias:
+        attn["bo"] = _stack(sd, pre + ".self_attention.dense.bias", L)
+    mlp = {"w_up": _stack(sd, pre + ".mlp.dense_h_to_4h.weight", L, True),
+           "w_down": _stack(sd, pre + ".mlp.dense_4h_to_h.weight", L, True)}
+    if cfg.proj_bias:
+        mlp["b_up"] = _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L)
+        mlp["b_down"] = _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L)
+    layers = {"attn": attn, "mlp": mlp}
+    if cfg.parallel_shared_norm:       # 7b-style: one shared input_layernorm
+        layers["ln1"] = _ln(sd, pre + ".input_layernorm", L)
+    elif cfg.parallel_block:           # 40b-style: ln_attn + ln_mlp
+        layers["ln1"] = _ln(sd, pre + ".ln_attn", L)
+        layers["ln2"] = _ln(sd, pre + ".ln_mlp", L)
+    else:
+        layers["ln1"] = _ln(sd, pre + ".input_layernorm", L)
+        layers["ln2"] = _ln(sd, pre + ".post_attention_layernorm", L)
+    return {
+        "embed": {"tokens": sd.pop("transformer.word_embeddings.weight")},
+        "layers": layers,
+        "final_norm": {"scale": sd.pop("transformer.ln_f.weight"),
+                       "bias": sd.pop("transformer.ln_f.bias")},
+    }, "lm_head.weight"
+
+
+def _build_gpt_neox(sd, cfg: TransformerConfig, model_type: str):
+    L, D = cfg.num_layers, cfg.hidden_size
+    H, hd = cfg.num_heads, cfg.head_dim
+    pre = "gpt_neox.layers.{}"
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in range(L):
+        # fused layout: rows interleaved per head [H, (q|k|v), hd]
+        w = sd.pop(f"gpt_neox.layers.{i}.attention.query_key_value.weight")
+        w = w.reshape(H, 3, hd, D)
+        qs.append(w[:, 0].reshape(H * hd, D).T)
+        ks.append(w[:, 1].reshape(H * hd, D).T)
+        vs.append(w[:, 2].reshape(H * hd, D).T)
+        b = sd.pop(f"gpt_neox.layers.{i}.attention.query_key_value.bias")
+        b = b.reshape(H, 3, hd)
+        bqs.append(b[:, 0].reshape(H * hd))
+        bks.append(b[:, 1].reshape(H * hd))
+        bvs.append(b[:, 2].reshape(H * hd))
+    attn = {"wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
+            "wo": _stack(sd, pre + ".attention.dense.weight", L, True),
+            "bo": _stack(sd, pre + ".attention.dense.bias", L)}
+    mlp = {"w_up": _stack(sd, pre + ".mlp.dense_h_to_4h.weight", L, True),
+           "b_up": _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L),
+           "w_down": _stack(sd, pre + ".mlp.dense_4h_to_h.weight", L, True),
+           "b_down": _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L)}
+    params = {
+        "embed": {"tokens": sd.pop("gpt_neox.embed_in.weight")},
+        "layers": {"ln1": _ln(sd, pre + ".input_layernorm", L),
+                   "ln2": _ln(sd, pre + ".post_attention_layernorm", L),
+                   "attn": attn, "mlp": mlp},
+        "final_norm": {"scale": sd.pop("gpt_neox.final_layer_norm.weight"),
+                       "bias": sd.pop("gpt_neox.final_layer_norm.bias")},
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = np.ascontiguousarray(sd.pop("lm_head.weight").T)
+        params["lm_head"] = np.ascontiguousarray(sd.pop("embed_out.weight").T)
+    return params, "embed_out.weight"
+
+
+def _build_gpt2(sd, cfg: TransformerConfig, model_type: str):
+    L, D = cfg.num_layers, cfg.hidden_size
+    # GPT2LMHeadModel exports prefix with "transformer.", the original gpt2
+    # release doesn't — normalize in place (callers hold this dict)
+    for k in list(sd):
+        if k.startswith("transformer."):
+            sd[k[len("transformer."):]] = sd.pop(k)
+    # gpt2 Conv1D stores [in, out] — no transpose anywhere
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in range(L):
+        w = sd.pop(f"h.{i}.attn.c_attn.weight")  # [D, 3D], cols q|k|v
+        q, k, v = np.split(w, 3, axis=1)
+        qs.append(q), ks.append(k), vs.append(v)
+        b = sd.pop(f"h.{i}.attn.c_attn.bias")
+        bq, bk, bv = np.split(b, 3)
+        bqs.append(bq), bks.append(bk), bvs.append(bv)
+    attn = {"wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
+            "wo": _stack(sd, "h.{}.attn.c_proj.weight", L),
+            "bo": _stack(sd, "h.{}.attn.c_proj.bias", L)}
+    mlp = {"w_up": _stack(sd, "h.{}.mlp.c_fc.weight", L),
+           "b_up": _stack(sd, "h.{}.mlp.c_fc.bias", L),
+           "w_down": _stack(sd, "h.{}.mlp.c_proj.weight", L),
+           "b_down": _stack(sd, "h.{}.mlp.c_proj.bias", L)}
+    return {
+        "embed": {"tokens": sd.pop("wte.weight"), "pos": sd.pop("wpe.weight")},
+        "layers": {"ln1": _ln(sd, "h.{}.ln_1", L),
+                   "ln2": _ln(sd, "h.{}.ln_2", L),
+                   "attn": attn, "mlp": mlp},
+        "final_norm": {"scale": sd.pop("ln_f.weight"),
+                       "bias": sd.pop("ln_f.bias")},
+    }, "lm_head.weight"
+
+
+def _build_opt(sd, cfg: TransformerConfig, model_type: str):
+    L = cfg.num_layers
+    pre = "model.decoder.layers.{}"
+    attn = {
+        "wq": _stack(sd, pre + ".self_attn.q_proj.weight", L, True),
+        "bq": _stack(sd, pre + ".self_attn.q_proj.bias", L),
+        "wk": _stack(sd, pre + ".self_attn.k_proj.weight", L, True),
+        "bk": _stack(sd, pre + ".self_attn.k_proj.bias", L),
+        "wv": _stack(sd, pre + ".self_attn.v_proj.weight", L, True),
+        "bv": _stack(sd, pre + ".self_attn.v_proj.bias", L),
+        "wo": _stack(sd, pre + ".self_attn.out_proj.weight", L, True),
+        "bo": _stack(sd, pre + ".self_attn.out_proj.bias", L),
+    }
+    mlp = {"w_up": _stack(sd, pre + ".fc1.weight", L, True),
+           "b_up": _stack(sd, pre + ".fc1.bias", L),
+           "w_down": _stack(sd, pre + ".fc2.weight", L, True),
+           "b_down": _stack(sd, pre + ".fc2.bias", L)}
+    # OPT's learned positions live at offset 2 (rows 0-1 are pad relics);
+    # slicing here makes our arange-positions lookup exact
+    pos = sd.pop("model.decoder.embed_positions.weight")[2:]
+    return {
+        "embed": {"tokens": sd.pop("model.decoder.embed_tokens.weight"),
+                  "pos": pos},
+        "layers": {"ln1": _ln(sd, pre + ".self_attn_layer_norm", L),
+                   "ln2": _ln(sd, pre + ".final_layer_norm", L),
+                   "attn": attn, "mlp": mlp},
+        "final_norm": {
+            "scale": sd.pop("model.decoder.final_layer_norm.weight"),
+            "bias": sd.pop("model.decoder.final_layer_norm.bias")},
+    }, "lm_head.weight"
+
+
+_PARAM_BUILDERS = {
+    **{m: _build_llama_family for m in _LLAMA_FAMILY},
+    "falcon": _build_falcon,
+    "gpt_neox": _build_gpt_neox,
+    "gpt2": _build_gpt2,
+    "opt": _build_opt,
+}
+
+# non-parameter buffers that older exports materialize — safe to drop
+_IGNORABLE_SUFFIXES = ("rotary_emb.inv_freq", "attn.bias", "attn.masked_bias",
+                       "attention.bias", "attention.masked_bias")
+
+
+def load_hf_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
+                       dtype: str = "float32") -> Tuple[TransformerLM, Any]:
+    """Import an HF checkpoint directory → (model, params).
+
+    Families: llama/mistral/qwen2/phi3/mixtral/falcon/gpt_neox/gpt2/opt
+    (the reference's v2 ``model_implementations/`` coverage).
+    ``cfg`` overrides the auto-derived config (e.g. to change dtype/remat).
+    """
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if cfg is None:
+        cfg = config_from_hf(hf_cfg, param_dtype="float32", dtype=dtype)
+    sd = _load_state_dict(path, np.dtype(cfg.param_dtype))
+    model_type = hf_cfg.get("model_type", "llama")
+    params, lm_head_key = _PARAM_BUILDERS[model_type](sd, cfg, model_type)
+    if not cfg.tie_embeddings and "lm_head" not in params:
+        params["lm_head"] = np.ascontiguousarray(sd.pop(lm_head_key).T)
     else:
-        sd.pop("lm_head.weight", None)  # some tied exports still materialize it
+        sd.pop(lm_head_key, None)  # some tied exports still materialize it
     # anything left means the architecture has weights we did not map —
     # importing would be silently wrong (e.g. qkv biases, extra norms)
-    leftovers = [k for k in sd if not k.endswith("rotary_emb.inv_freq")]
+    leftovers = [k for k in sd
+                 if not any(k.endswith(s) for s in _IGNORABLE_SUFFIXES)]
     if leftovers:
         raise ValueError(
             f"unmapped tensors in checkpoint (first 5): {leftovers[:5]} — "
             "this architecture is not fully supported")
+    L = cfg.num_layers
     import jax
 
     # TransformerLM derives the MoE dispatch from cfg.moe_dispatch itself
@@ -209,9 +525,21 @@ TP_PATTERNS: Tuple[Tuple[str, str], ...] = (
     # HF torch names ([out, in] layout → col shards dim -2, row shards dim -1)
     (r"(q|k|v)_proj\.weight$", "hf_col"),
     (r"(gate|up)_proj\.weight$", "hf_col"),
-    (r"(o|down)_proj\.weight$", "hf_row"),
-    (r"embed_tokens\.weight$", "vocab"),
-    (r"lm_head\.weight$", "hf_col"),
+    (r"(o|down|out)_proj\.weight$", "hf_row"),
+    (r"(fc1|dense_h_to_4h)\.weight$", "hf_col"),
+    (r"(fc2|dense_4h_to_h)\.weight$", "hf_row"),
+    (r"(attention|self_attention)\.dense\.weight$", "hf_row"),
+    # fused qkv: neox rows are per-head [H, 3, hd] and falcon rows are
+    # per-kv-group — both contiguous per head(-group), so col-sharding the
+    # fused out dim keeps whole heads per rank (valid when tp divides K)
+    (r"query_key_value\.weight$", "hf_col"),
+    # gpt2 Conv1D stores [in, out] → native col/row orientation. NOTE:
+    # c_attn is q|k|v concatenated on the out dim — col-sharding would split
+    # q from k/v, so it intentionally falls through to replication.
+    (r"c_fc\.weight$", "col"),
+    (r"c_proj\.weight$", "row"),
+    (r"(embed_tokens|word_embeddings|embed_in|wte)\.weight$", "vocab"),
+    (r"(lm_head|embed_out)\.weight$", "hf_col"),
     # MoE experts (ep on the expert dim is added separately)
     (r"experts.*w[13]\.weight$", "hf_col"),
     (r"experts.*w2\.weight$", "hf_row"),

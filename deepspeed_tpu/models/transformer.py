@@ -45,11 +45,19 @@ class TransformerConfig:
     arch: str = "llama"  # "llama" | "gpt2"
     # derived-from-arch defaults (overridable)
     norm: Optional[str] = None        # rmsnorm | layernorm
-    activation: Optional[str] = None  # swiglu | gelu
+    activation: Optional[str] = None  # swiglu | gelu | gelu_exact | relu
     use_rope: Optional[bool] = None
     learned_pos: Optional[bool] = None
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
+    # --- family knobs (reference: inference v2 model_implementations/ for
+    # llama/mistral/qwen2/phi3/falcon/opt; each maps to one switch here) ---
+    qkv_bias: bool = False        # qwen/qwen2 (bias on q/k/v only)
+    proj_bias: bool = False       # gpt2/opt/gpt-neox/falcon(bias=True): wo + mlp
+    parallel_block: bool = False  # falcon/gpt-neox: x + attn(ln(x)) + mlp(ln(x))
+    parallel_shared_norm: bool = False  # falcon-7b: one ln feeds both branches
+    rope_pct: float = 1.0         # gpt-neox partial rotary (rotary_pct)
+    sliding_window: Optional[int] = None  # mistral/qwen2 windowed attention
     # HF-style rope_scaling dict ({"rope_type": "llama3"|"linear", ...});
     # None = unscaled
     rope_scaling: Optional[Dict[str, Any]] = None
@@ -90,10 +98,17 @@ class TransformerConfig:
             object.__setattr__(self, "intermediate_size", inter)
         assert self.hidden_size % self.num_heads == 0
         assert self.num_heads % self.num_kv_heads == 0
+        if self.parallel_shared_norm:
+            assert self.parallel_block, "shared norm requires parallel_block"
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def rope_dim(self) -> int:
+        """Rotary dims per head (gpt-neox style partial rotary when < head_dim)."""
+        return 2 * (int(self.head_dim * self.rope_pct) // 2)
 
     def num_params_estimate(self) -> int:
         D, F, V, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
@@ -140,8 +155,12 @@ def repeat_kv(k: jax.Array, v: jax.Array, num_heads: int):
 
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
-                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Reference attention: q[B,T,H,d], k/v[B,S,K,d] → [B,T,H,d]. GQA via head repeat."""
+                  segment_ids: Optional[jax.Array] = None,
+                  window: Optional[int] = None) -> jax.Array:
+    """Reference attention: q[B,T,H,d], k/v[B,S,K,d] → [B,T,H,d]. GQA via head repeat.
+
+    ``window`` masks keys more than ``window-1`` positions behind each query
+    (mistral/qwen2 sliding-window attention)."""
     B, T, H, d = q.shape
     S = k.shape[1]
     k, v = repeat_kv(k, v, H)
@@ -149,6 +168,10 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = Tr
     mask = None
     if causal:
         mask = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)[None, None]
+    if window is not None:
+        tpos = jnp.arange(T)[:, None] + (S - T)
+        in_win = jnp.arange(S)[None, :] > tpos - window
+        mask = in_win[None, None] if mask is None else (mask & in_win[None, None])
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = seg if mask is None else (mask & seg)
@@ -206,16 +229,43 @@ def rope_frequencies(head_dim: int, max_seq: int, theta: float,
 
 def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = None
                ) -> jax.Array:
-    """x: [B, T, H, d]; freqs: [max_seq, d//2]; positions: [B, T] (default arange)."""
+    """x: [B, T, H, d]; freqs: [max_seq, rd//2]; positions: [B, T] (default arange).
+
+    When ``2*freqs.shape[-1] < d`` only the leading rotary dims rotate and the
+    tail passes through (gpt-neox/phi partial rotary, ``rotary_pct``)."""
     B, T = x.shape[0], x.shape[1]
+    rd = 2 * freqs.shape[-1]
+    tail = None
+    if rd < x.shape[-1]:
+        x, tail = x[..., :rd], x[..., rd:]
     if positions is None:
-        f = freqs[:T][None, :, None, :]  # [1, T, 1, d/2]
+        f = freqs[:T][None, :, None, :]  # [1, T, 1, rd/2]
     else:
-        f = freqs[positions][:, :, None, :]  # [B, T, 1, d/2]
+        f = freqs[positions][:, :, None, :]  # [B, T, 1, rd/2]
     cos, sin = jnp.cos(f), jnp.sin(f)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = out.astype(x.dtype)
+    return out if tail is None else jnp.concatenate([out, tail], axis=-1)
+
+
+def qkv_proj(x: jax.Array, w: Params, cfg: TransformerConfig):
+    """Shared q/k/v projection (+ optional qwen-style biases) for every
+    forward path (train, dense decode, paged decode)."""
+    B, T = x.shape[0], x.shape[1]
+    hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    q, k, v = x @ w["wq"], x @ w["wk"], x @ w["wv"]
+    if "bq" in w:
+        q, k, v = q + w["bq"], k + w["bk"], v + w["bv"]
+    return (q.reshape(B, T, H, hd), k.reshape(B, T, K, hd),
+            v.reshape(B, T, K, hd))
+
+
+def attn_out_proj(attn: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
+    """[B, T, H, hd] attention output → [B, T, D] (+ optional bias)."""
+    B, T = attn.shape[0], attn.shape[1]
+    o = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ w["wo"]
+    return o + w["bo"] if "bo" in w else o
 
 
 def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
@@ -224,9 +274,7 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                     kv_cache: Optional[Dict[str, jax.Array]] = None) -> Any:
     B, T, D = x.shape
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-    q = (x @ w["wq"]).reshape(B, T, H, hd)
-    k = (x @ w["wk"]).reshape(B, T, K, hd)
-    v = (x @ w["wv"]).reshape(B, T, K, hd)
+    q, k, v = qkv_proj(x, w, cfg)
     q = constrain(q, P(("dp", "fsdp"), "sp", "tp", None))
     k = constrain(k, P(("dp", "fsdp"), "sp", "tp", None))
     if cfg.use_rope:
@@ -238,12 +286,20 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
         ck = jax.lax.dynamic_update_slice(kv_cache["k"], k, (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(kv_cache["v"], v, (0, pos, 0, 0))
         S = ck.shape[1]
-        out = decode_attention(q, ck, cv, valid=jnp.arange(S)[None, :] < pos + T)
+        sidx = jnp.arange(S)[None, :]
+        valid = sidx < pos + T
+        if cfg.sliding_window is not None:
+            valid = valid & (sidx >= pos + T - cfg.sliding_window)
+        out = decode_attention(q, ck, cv, valid=valid)
         new_cache = {"k": ck, "v": cv, "pos": pos + T}
-        o = out.reshape(B, T, H * hd) @ w["wo"]
-        return o, new_cache
-    out = attn_fn(q, k, v, causal=True)
-    o = out.reshape(B, T, H * hd) @ w["wo"]
+        return attn_out_proj(out, w, cfg), new_cache
+    if cfg.sliding_window is not None:
+        # the pallas flash/ring kernels have no window support: windowed
+        # families (mistral/qwen2) route through the masked XLA path
+        out = xla_attention(q, k, v, causal=True, window=cfg.sliding_window)
+    else:
+        out = attn_fn(q, k, v, causal=True)
+    o = attn_out_proj(out, w, cfg)
     return constrain(o, P(("dp", "fsdp"), "sp", None)), None
 
 
@@ -267,13 +323,41 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+def _decode_block(h: jax.Array, wc: Params, cfg: TransformerConfig,
+                  freqs: Optional[jax.Array], positions: jax.Array,
+                  attn_cache_fn: Callable) -> jax.Array:
+    """One decoder block on the decode path. ``attn_cache_fn(q, k, v)`` owns
+    the cache append + attention and returns [B, t, H, hd]. Mirrors
+    :func:`transformer_block` (parallel residual, shared norm, biases)."""
+    hn1 = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
+    q, k, v = qkv_proj(hn1, wc["attn"], cfg)
+    if cfg.use_rope:
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+    attn_out = attn_out_proj(attn_cache_fn(q, k, v), wc["attn"], cfg)
+    if cfg.parallel_block:
+        hn2 = (hn1 if cfg.parallel_shared_norm
+               else _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps))
+        return h + attn_out + mlp_block(hn2, wc["mlp"], cfg)
+    h = h + attn_out
+    hn2 = _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps)
+    return h + mlp_block(hn2, wc["mlp"], cfg)
+
+
 def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
     if cfg.activation == "swiglu":
         h = jax.nn.silu(x @ w["w_gate"]) * (x @ w["w_up"])
     else:
-        h = jax.nn.gelu(x @ w["w_up"], approximate=True)
+        # gelu = tanh-approx (HF gelu_new/gelu_pytorch_tanh, gpt2 family);
+        # gelu_exact = erf gelu (HF "gelu": falcon/gpt-neox); relu = opt
+        act = {"gelu": partial(jax.nn.gelu, approximate=True),
+               "gelu_exact": partial(jax.nn.gelu, approximate=False),
+               "relu": jax.nn.relu}[cfg.activation]
+        up = x @ w["w_up"]
+        h = act(up + w["b_up"] if "b_up" in w else up)
     h = constrain(h, P(("dp", "fsdp"), "sp", "tp"))
-    return h @ w["w_down"]
+    out = h @ w["w_down"]
+    return out + w["b_down"] if "b_down" in w else out
 
 
 def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
@@ -284,16 +368,21 @@ def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     overrides RoPE positions (random-LTD token subsets)."""
     dt = jnp.dtype(cfg.dtype)
     wc = jax.tree_util.tree_map(lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, w)
-    attn_out, _ = attention_block(_norm(x, wc["ln1"], cfg.norm, cfg.norm_eps),
-                                  wc["attn"], cfg, freqs, attn_fn,
+    hn1 = _norm(x, wc["ln1"], cfg.norm, cfg.norm_eps)
+    attn_out, _ = attention_block(hn1, wc["attn"], cfg, freqs, attn_fn,
                                   positions=positions)
-    x = x + attn_out
-    h = _norm(x, wc["ln2"], cfg.norm, cfg.norm_eps)
+    if cfg.parallel_block:
+        # falcon/gpt-neox: attn and mlp branch from the SAME residual input
+        h = hn1 if cfg.parallel_shared_norm else _norm(x, wc["ln2"], cfg.norm,
+                                                       cfg.norm_eps)
+    else:
+        x = x + attn_out
+        h = _norm(x, wc["ln2"], cfg.norm, cfg.norm_eps)
     if moe_fn is not None:
         mlp_out, aux = moe_fn(h, wc["mlp"], cfg)
     else:
         mlp_out, aux = mlp_block(h, wc["mlp"], cfg), jnp.zeros((), jnp.float32)
-    x = x + mlp_out
+    x = x + mlp_out + attn_out if cfg.parallel_block else x + mlp_out
     return constrain(x, P(("dp", "fsdp"), "sp", None)), aux
 
 
@@ -341,7 +430,7 @@ class TransformerLM:
 
             moe_fn = moe_block_for(cfg)
         self.moe_fn = moe_fn
-        self._freqs = (rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+        self._freqs = (rope_frequencies(cfg.rope_dim, cfg.max_seq_len,
                                         cfg.rope_theta, cfg.rope_scaling)
                        if cfg.use_rope else None)
         # random-LTD (data_routing/basic_layer.py parity): when set, layers in
@@ -376,12 +465,27 @@ class TransformerLM:
         norm_w = {"scale": jnp.ones((L, D), pd)}
         if cfg.norm == "layernorm":
             norm_w["bias"] = jnp.zeros((L, D), pd)
+        attn_w = {
+            "wq": layer_stack(keys[1], D, (D, H * hd)),
+            "wk": layer_stack(keys[2], D, (D, K * hd)),
+            "wv": layer_stack(keys[10], D, (D, K * hd)),
+            "wo": layer_stack(keys[3], H * hd, (H * hd, D)),
+        }
+        if cfg.qkv_bias:
+            attn_w["bq"] = jnp.zeros((L, H * hd), pd)
+            attn_w["bk"] = jnp.zeros((L, K * hd), pd)
+            attn_w["bv"] = jnp.zeros((L, K * hd), pd)
+        if cfg.proj_bias:
+            attn_w["bo"] = jnp.zeros((L, D), pd)
         mlp = ({"w_gate": layer_stack(keys[4], D, (D, F)),
                 "w_up": layer_stack(keys[5], D, (D, F)),
                 "w_down": layer_stack(keys[6], F, (F, D))}
                if cfg.activation == "swiglu" else
                {"w_up": layer_stack(keys[5], D, (D, F)),
                 "w_down": layer_stack(keys[6], F, (F, D))})
+        if cfg.proj_bias and cfg.activation != "swiglu":
+            mlp["b_up"] = jnp.zeros((L, F), pd)
+            mlp["b_down"] = jnp.zeros((L, D), pd)
         if cfg.num_experts > 1:
             E = cfg.num_experts
             mlp = ({"w_gate": layer_stack(keys[4], D, (E, D, F)),
@@ -391,18 +495,12 @@ class TransformerLM:
                    {"w_up": layer_stack(keys[5], D, (E, D, F)),
                     "w_down": layer_stack(keys[6], F, (E, F, D))})
             mlp["router"] = layer_stack(keys[7], D, (D, E))
+        layers: Params = {"ln1": dict(norm_w), "attn": attn_w, "mlp": mlp}
+        if not cfg.parallel_shared_norm:
+            layers["ln2"] = jax.tree_util.tree_map(jnp.copy, norm_w)
         params: Params = {
             "embed": {"tokens": dense(keys[0], 1, (V, D)) * 0.02 * math.sqrt(1)},
-            "layers": {
-                "ln1": dict(norm_w), "ln2": jax.tree_util.tree_map(jnp.copy, norm_w),
-                "attn": {
-                    "wq": layer_stack(keys[1], D, (D, H * hd)),
-                    "wk": layer_stack(keys[2], D, (D, K * hd)),
-                    "wv": layer_stack(keys[10], D, (D, K * hd)),
-                    "wo": layer_stack(keys[3], H * hd, (H * hd, D)),
-                },
-                "mlp": mlp,
-            },
+            "layers": layers,
             "final_norm": {"scale": jnp.ones((D,), pd)},
         }
         if cfg.norm == "layernorm":
@@ -569,29 +667,26 @@ class TransformerLM:
         freqs = self._freqs
 
         def body(carry, xs):
-            h = carry
             layer_w, ck, cv = xs
             wc = jax.tree_util.tree_map(
                 lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
-            hn = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
-            hd_, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-            q = (hn @ wc["attn"]["wq"]).reshape(B, t, H, hd_)
-            k = (hn @ wc["attn"]["wk"]).reshape(B, t, K, hd_)
-            v = (hn @ wc["attn"]["wv"]).reshape(B, t, K, hd_)
-            if cfg.use_rope:
-                q = apply_rope(q, freqs, positions)
-                k = apply_rope(k, freqs, positions)
-            # per-sequence scatter of the new kv at each slot's position
-            bidx = jnp.arange(B)[:, None] + jnp.zeros((1, t), jnp.int32)
-            sidx = positions
-            ck = ck.at[bidx, sidx].set(k.astype(ck.dtype))
-            cv = cv.at[bidx, sidx].set(v.astype(cv.dtype))
-            valid = (jnp.arange(S)[None, None, :] <= positions[:, :, None])  # [B,t,S]
-            attn = _cached_attention(q, ck, cv, valid)
-            h = h + attn.reshape(B, t, H * hd_) @ wc["attn"]["wo"]
-            hn2 = _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps)
-            h = h + mlp_block(hn2, wc["mlp"], cfg)
-            return h, (ck, cv)
+            new_kv = {}
+
+            def attn_cache_fn(q, k, v):
+                # per-sequence scatter of the new kv at each slot's position
+                bidx = jnp.arange(B)[:, None] + jnp.zeros((1, t), jnp.int32)
+                nk = ck.at[bidx, positions].set(k.astype(ck.dtype))
+                nv = cv.at[bidx, positions].set(v.astype(cv.dtype))
+                new_kv["k"], new_kv["v"] = nk, nv
+                sidx = jnp.arange(S)[None, None, :]
+                valid = sidx <= positions[:, :, None]  # [B,t,S]
+                if cfg.sliding_window is not None:
+                    valid = valid & (sidx > positions[:, :, None]
+                                     - cfg.sliding_window)
+                return _cached_attention(q, nk, nv, valid)
+
+            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn)
+            return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
@@ -631,6 +726,11 @@ class TransformerLM:
                                                        paged_update)
 
         cfg = self.cfg
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "paged attention has no sliding-window mask yet — serving a "
+                "windowed family (mistral/qwen2) through the paged path would "
+                "silently attend beyond the window; use the dense KV cache")
         dt = jnp.dtype(cfg.dtype)
         B, t = input_ids.shape
         positions = pos[:, None] + jnp.arange(t, dtype=pos.dtype)[None, :]
@@ -641,25 +741,19 @@ class TransformerLM:
         freqs = self._freqs
 
         def body(carry, xs):
-            h = carry
             layer_w, kp, vp = xs
             wc = jax.tree_util.tree_map(
                 lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
-            hn = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
-            hd_, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-            q = (hn @ wc["attn"]["wq"]).reshape(B, t, H, hd_)
-            k = (hn @ wc["attn"]["wk"]).reshape(B, t, K, hd_)
-            v = (hn @ wc["attn"]["wv"]).reshape(B, t, K, hd_)
-            if cfg.use_rope:
-                q = apply_rope(q, freqs, positions)
-                k = apply_rope(k, freqs, positions)
-            kp = paged_update(kp, k, block_tables, pos, valid)
-            vp = paged_update(vp, v, block_tables, pos, valid)
-            attn = paged_attention_tp(q, kp, vp, block_tables, pos)
-            h = h + attn.reshape(B, t, H * hd_) @ wc["attn"]["wo"]
-            hn2 = _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps)
-            h = h + mlp_block(hn2, wc["mlp"], cfg)
-            return h, (kp, vp)
+            new_kv = {}
+
+            def attn_cache_fn(q, k, v):
+                nk = paged_update(kp, k, block_tables, pos, valid)
+                nv = paged_update(vp, v, block_tables, pos, valid)
+                new_kv["k"], new_kv["v"] = nk, nv
+                return paged_attention_tp(q, nk, nv, block_tables, pos)
+
+            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn)
+            return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x,
                                    (params["layers"], cache["k"], cache["v"]))
@@ -682,19 +776,28 @@ class TransformerLM:
                 "w_down": P(None, "tp", None)}
                if cfg.activation == "swiglu" else
                {"w_up": P(None, None, "tp"), "w_down": P(None, "tp", None)})
+        if cfg.proj_bias and cfg.activation != "swiglu" and cfg.num_experts <= 1:
+            mlp["b_up"] = P(None, "tp")
+            mlp["b_down"] = P(None, None)
         if cfg.num_experts > 1:
             mlp = {"w_gate": P(None, "ep", None, "tp"), "w_up": P(None, "ep", None, "tp"),
                    "w_down": P(None, "ep", "tp", None), "router": P(None, None, None)}
             if cfg.activation != "swiglu":
                 mlp.pop("w_gate")
+        attn_spec = {"wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
+                     "wv": P(None, None, "tp"), "wo": P(None, "tp", None)}
+        if cfg.qkv_bias:
+            attn_spec["bq"] = P(None, "tp")
+            attn_spec["bk"] = P(None, "tp")
+            attn_spec["bv"] = P(None, "tp")
+        if cfg.proj_bias:
+            attn_spec["bo"] = P(None, None)
+        layer_specs: Params = {"ln1": norm_spec, "attn": attn_spec, "mlp": mlp}
+        if not cfg.parallel_shared_norm:
+            layer_specs["ln2"] = dict(norm_spec)
         specs: Params = {
             "embed": {"tokens": P("tp", None)},
-            "layers": {
-                "ln1": norm_spec, "ln2": dict(norm_spec),
-                "attn": {"wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
-                         "wv": P(None, None, "tp"), "wo": P(None, "tp", None)},
-                "mlp": mlp,
-            },
+            "layers": layer_specs,
             "final_norm": {"scale": P(None)},
         }
         if cfg.norm == "layernorm":
