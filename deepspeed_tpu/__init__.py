@@ -10,6 +10,7 @@ from deepspeed_tpu.version import __version__  # noqa: F401
 
 from deepspeed_tpu import comm  # noqa: F401
 from deepspeed_tpu import ops  # noqa: F401  (registers Pallas kernels, e.g. 'flash')
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator  # noqa: F401
 from deepspeed_tpu.config import DeepSpeedTpuConfig, from_config  # noqa: F401
 from deepspeed_tpu.parallel import Topology, build_mesh  # noqa: F401
 

@@ -1,0 +1,37 @@
+"""CPU implementation of the accelerator abstraction (reference
+``cpu_accelerator.py``): the CI / virtual-mesh platform. With
+``--xla_force_host_platform_device_count=N`` it exposes N devices, which is how
+the test suite runs every multi-chip sharding test without hardware."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class CpuAccelerator(DeepSpeedAccelerator):
+    _name = "cpu"
+
+    def devices(self) -> List[Any]:
+        import jax
+
+        return jax.devices("cpu")
+
+    def is_bf16_supported(self) -> bool:
+        return True  # emulated; numerics match, throughput doesn't
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def memory_stats(self, device_index=None):
+        # jax CPU devices expose no memory_stats; report host memory
+        try:
+            import os
+
+            page = os.sysconf("SC_PAGE_SIZE")
+            total = os.sysconf("SC_PHYS_PAGES") * page
+            avail = os.sysconf("SC_AVPHYS_PAGES") * page
+            return {"bytes_limit": total, "bytes_in_use": total - avail}
+        except (ValueError, OSError):
+            return {}

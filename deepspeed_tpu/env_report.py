@@ -33,6 +33,16 @@ def report() -> str:
         except Exception:
             lines.append(f"{mod}: NOT FOUND")
     try:
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        acc = get_accelerator()
+        lines.append(
+            f"accelerator: {acc.device_type()} "
+            f"(comm={acc.communication_backend_name()}, "
+            f"bf16={acc.is_bf16_supported()}, fp8={acc.is_fp8_supported()})")
+    except Exception as e:
+        lines.append(f"accelerator selection failed: {e}")
+    try:
         devs = jax.devices()
         lines.append(f"backend: {jax.default_backend()}  devices: {len(devs)}")
         for d in devs[:8]:

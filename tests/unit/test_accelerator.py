@@ -1,0 +1,98 @@
+"""Accelerator abstraction tests (reference tests/unit/accelerator/):
+selection logic, capability surface, op-builder seam."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator import (CpuAccelerator, DeepSpeedAccelerator,
+                                       TpuAccelerator, get_accelerator,
+                                       set_accelerator)
+
+
+@pytest.fixture(autouse=True)
+def _reset_accelerator():
+    yield
+    set_accelerator(None)  # tests must not leak a forced accelerator
+
+
+def test_auto_detect_matches_backend(eight_devices):
+    import jax
+
+    acc = get_accelerator()
+    assert isinstance(acc, DeepSpeedAccelerator)
+    expected = "cpu" if jax.default_backend() == "cpu" else "tpu"
+    assert acc.device_type() == expected
+    assert acc.is_available()
+    assert acc.device_count() == len(jax.devices())
+
+
+def test_env_override_and_reset(monkeypatch):
+    set_accelerator(None)
+    monkeypatch.setenv("DS_ACCELERATOR", "tpu")
+    assert isinstance(get_accelerator(), TpuAccelerator)
+    # cached: changing env later doesn't flip silently
+    monkeypatch.setenv("DS_ACCELERATOR", "cpu")
+    assert isinstance(get_accelerator(), TpuAccelerator)
+    set_accelerator(None)
+    assert isinstance(get_accelerator(), CpuAccelerator)
+    set_accelerator(None)
+    monkeypatch.setenv("DS_ACCELERATOR", "bogus")
+    with pytest.raises(ValueError):
+        get_accelerator()
+
+
+def test_capability_surface(eight_devices):
+    acc = get_accelerator()
+    assert acc.communication_backend_name() == "xla"
+    assert acc.is_bf16_supported()
+    import jax.numpy as jnp
+
+    assert jnp.float32 in acc.supported_dtypes()
+    assert jnp.bfloat16 in acc.supported_dtypes()
+    # memory introspection returns ints (zeros allowed on platforms
+    # without stats)
+    assert isinstance(acc.memory_allocated(), int)
+    assert isinstance(acc.total_memory(), int)
+    assert "cpu" in acc.device_name(0) or "TPU" in acc.device_name(0) or \
+        "Cpu" in acc.device_name(0)
+
+
+def test_rng_and_sync(eight_devices):
+    import jax
+
+    acc = get_accelerator()
+    k1, k2 = acc.manual_seed(7), acc.manual_seed(7)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    acc.synchronize()  # must not raise
+
+
+def test_device_context_places_computation(eight_devices):
+    import jax
+    import jax.numpy as jnp
+
+    acc = get_accelerator()
+    target = acc.devices()[-1]
+    with acc.device(acc.device_count() - 1):
+        x = jnp.ones((2,)) * 2
+    assert list(x.devices()) == [target]
+    assert acc.on_accelerator(x)
+
+
+def test_op_builder_seam():
+    acc = get_accelerator()
+    from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
+
+    assert acc.get_op_builder("CPUAdamBuilder") is CPUAdamBuilder
+    assert acc.get_op_builder("cpu_adam") is CPUAdamBuilder
+    assert acc.get_op_builder("async_io") is AsyncIOBuilder
+    b = acc.create_op_builder("cpu_adam")
+    assert isinstance(b, CPUAdamBuilder) and b.is_compatible()
+    assert acc.get_op_builder("nope") is None
+
+
+def test_graph_capture_is_jit(eight_devices):
+    acc = get_accelerator()
+    fn = acc.graph_capture(lambda x: x * 2)
+    assert float(fn(3.0)) == 6.0
