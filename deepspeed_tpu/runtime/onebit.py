@@ -28,10 +28,16 @@ collectives — same reasoning as ``parallel/zeropp.py``):
   recurrence (``g_avg = (m_avg - b1*m)/(1-b1)``), and the grad-norm is a
   scalar psum — total per-step wire volume is 2 bits/element.
 
-Simplifications vs the reference (kept honest in PARITY.md): ZeroOneAdam here
-is 1-bit Adam with a longer variance-update window (``var_freeze_step``); its
-exponentially-spaced variance schedule and local-step communication skipping
-are not replicated.
+ZeroOneAdam implements the full 0/1 Adam policy (``zoadam.py:189-292``):
+an exponentially-spaced variance schedule (dense allreduce only on
+``step % var_interval == 0`` steps, interval doubling every
+``var_update_scaler`` variance updates; 1-bit compressed gradient allreduce on
+the steps in between), and after ``var_freeze_step`` the local-step regime —
+workers take pure-local Adam steps with NO collective at all, accumulating
+their updates in a momentum accumulator that is compressed-allreduced every
+``local_step_interval`` steps (interval doubling every ``local_step_scaler``
+steps, clipped at ``local_step_clipper``), after which parameters and momentum
+re-synchronize from the averaged accumulator.
 
 Stage restriction (same as the reference, onebit/adam.py docstring): ZeRO
 stage <= 1 — grads must be whole-tensor per device for local momentum.
@@ -199,6 +205,10 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
     var_freeze = int(opt_params.get("var_freeze_step",
                                     freeze_step if kind == "onebitadam"
                                     else 4 * freeze_step))
+    # 0/1 Adam schedule knobs (zoadam.py defaults)
+    var_update_scaler = int(opt_params.get("var_update_scaler", 16))
+    local_step_scaler = int(opt_params.get("local_step_scaler", 32678))
+    local_step_clipper = int(opt_params.get("local_step_clipper", 16))
 
     manual = set(batch_axes)
     tp = topology.axis_sizes.get("tp", 1)
@@ -270,11 +280,159 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
 
         e_w = jax.tree_util.tree_map(err, params, pspecs)
         e_s = jax.tree_util.tree_map(err_s, params, pspecs)
-        return {"m": m, "v": v, "e_w": e_w, "e_s": e_s,
-                "step": jnp.zeros((), jnp.int32)}
+        state = {"m": m, "v": v, "e_w": e_w, "e_s": e_s,
+                 "step": jnp.zeros((), jnp.int32)}
+        if kind == "zerooneadam":
+            # u = the 0/1 Adam momentum accumulator (zoadam.py
+            # 'momentum_accumulator'); scalars drive the two exponential
+            # schedules (shared across leaves — the reference keeps identical
+            # per-param copies)
+            state["u"] = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(np.shape(p), jnp.float32), params)
+            state["var_interval"] = jnp.ones((), jnp.int32)
+            state["var_counter"] = jnp.zeros((), jnp.int32)
+            state["local_interval"] = jnp.ones((), jnp.int32)
+            state["local_counter"] = jnp.zeros((), jnp.int32)
+            state["lrs"] = jnp.zeros((), jnp.float32)
+        return state
+
+    def leaf_compressed_allreduce(x, w, s):
+        """Error-feedback 1-bit mean of ONE local leaf over the comm axis —
+        the single implementation of the pad/compress/unpad dance both apply
+        paths share. Small leaves (size-1 error buffers) fall back to dense
+        pmean."""
+        nloc = int(np.prod(x.shape))
+        if w.shape[-1] > 1 and W > 1:
+            flat = x.ravel()
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((w.shape[-1] - nloc,), flat.dtype)])
+            out, w2, s2 = compressed_allreduce(flat, w[0, 0], s[0, 0],
+                                               comm_axis)
+            return out[:nloc].reshape(x.shape), w2[None, None], s2[None, None]
+        return (lax.pmean(x, comm_axis) if W > 1 else x), w, s
+
+    def _finish_gnorm(gnorm_sq):
+        """Replicate the squared grad norm across every manual axis: the
+        engine reads one shard of this scalar as THE global norm, so it must
+        agree on all devices (phase-2 local grads differ per device)."""
+        if manual:
+            gnorm_sq = lax.pmean(gnorm_sq, comm_axis) if W > 1 else gnorm_sq
+            if "tp" in opt_manual:
+                gnorm_sq = lax.psum(gnorm_sq, "tp")
+        return jnp.sqrt(gnorm_sq)
+
+    # ---- 0/1 Adam apply region (zoadam.py:189-292 parity) ---------------
+    def _apply_local_zeroone(params, state, grads, denom):
+        """All leaves fully local. Three per-step modes selected by the two
+        exponential schedules:
+          0) variance step (phase 1, step % var_interval == 0): DENSE grad
+             allreduce, m and v both update — the reference's
+             enable_backward_allreduce=True steps;
+          1) compressed step (phase 1 otherwise): 1-bit grad allreduce,
+             m updates, v frozen;
+          2) local step (phase 2, step > var_freeze): no collective; every
+             local_interval steps the accumulated update u syncs via one
+             compressed allreduce and p/m re-anchor from it.
+        No Adam bias correction — the reference applies none."""
+        step = state["step"] + 1
+        var_interval = state["var_interval"]
+        local_interval = state["local_interval"]
+        lr_now = (lr if schedule_fn is None else schedule_fn(state["step"]))
+        frozen = step > var_freeze
+        is_var_step = jnp.logical_and(jnp.logical_not(frozen),
+                                      step % var_interval == 0)
+        is_sync = jnp.logical_and(frozen, step % local_interval == 0)
+        # error buffers switch metric at the phase boundary (grad → momentum
+        # accumulator): reinitialize once, like reinitial_error_buffer
+        reinit = step == var_freeze + 1
+        lrs = jnp.where(frozen, state["lrs"] + lr_now, state["lrs"])
+        gnorm_sq_parts = []
+
+        def leaf_update(p, g, m, v, ew, es, u):
+            g = g.astype(jnp.float32) / denom
+            ew = jnp.where(reinit, 0.0, ew)
+            es = jnp.where(reinit, 0.0, es)
+            car = leaf_compressed_allreduce
+
+            def dense_mean(x):
+                return lax.pmean(x, comm_axis) if W > 1 else x
+
+            def var_branch(args):
+                g, m, v, ew, es = args
+                ga = dense_mean(g)
+                return (b1 * m + (1 - b1) * ga,
+                        b2 * v + (1 - b2) * jnp.square(ga), ew, es, ga)
+
+            def cmp_branch(args):
+                g, m, v, ew, es = args
+                gc, ew2, es2 = car(g, ew, es)
+                return b1 * m + (1 - b1) * gc, v, ew2, es2, gc
+
+            def local_branch(args):
+                g, m, v, ew, es = args
+                return b1 * m + (1 - b1) * g, v, ew, es, g
+
+            mode = jnp.where(is_var_step, 0, jnp.where(frozen, 2, 1))
+            m2, v2, ew2, es2, gref = lax.switch(
+                mode, [var_branch, cmp_branch, local_branch],
+                (g, m, v, ew, es))
+            gnorm_sq_parts.append(jnp.sum(jnp.square(gref)))
+            vsd = jnp.sqrt(v2) + eps
+            upd = m2 / vsd
+            if wd > 0:
+                upd = upd + wd * p
+            p2 = p - lr_now * upd
+            u2 = jnp.where(frozen, u - lr_now * upd, u)
+
+            def sync(args):
+                p2, m2, u2, ew2, es2 = args
+                # rewind the local window, average it in momentum units,
+                # then replay the averaged update (zoadam.py:249-264)
+                p3 = p2 - u2
+                t = u2 * vsd
+                t_avg, ew3, es3 = car(t, ew2, es2)
+                m3 = -t_avg / jnp.maximum(lrs, 1e-20)
+                p4 = p3 + t_avg / vsd
+                return p4, m3, jnp.zeros_like(u2), ew3, es3
+
+            p2, m2, u2, ew2, es2 = lax.cond(
+                is_sync, sync, lambda a: a, (p2, m2, u2, ew2, es2))
+            return p2, m2, v2, ew2, es2, u2
+
+        out = jax.tree_util.tree_map(
+            leaf_update, params, grads, state["m"], state["v"], state["e_w"],
+            state["e_s"], state["u"])
+        gnorm = _finish_gnorm(sum(gnorm_sq_parts))
+        split = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
+
+        # schedule bookkeeping (zoadam.py:271-292)
+        vc = jnp.where(is_var_step, state["var_counter"] + 1,
+                       state["var_counter"])
+        var_wrap = jnp.logical_and(is_var_step, vc >= var_update_scaler)
+        lc = jnp.where(frozen, state["local_counter"] + 1,
+                       state["local_counter"])
+        loc_wrap = jnp.logical_and(frozen, lc >= local_step_scaler)
+        new_state = {
+            "m": split(1), "v": split(2), "e_w": split(3), "e_s": split(4),
+            "u": split(5), "step": step,
+            "var_interval": jnp.where(var_wrap, var_interval * 2, var_interval),
+            "var_counter": jnp.where(var_wrap, 0, vc),
+            "local_interval": jnp.where(
+                loc_wrap, jnp.minimum(local_step_clipper, local_interval * 2),
+                local_interval),
+            "local_counter": jnp.where(loc_wrap, 0, lc),
+            "lrs": jnp.where(is_sync, 0.0, lrs),
+        }
+        return split(0), new_state, gnorm
 
     # ---- the apply region (manual over comm axis + tp) ------------------
     def _apply_local(params, state, grads, denom):
+        if kind == "zerooneadam":
+            return _apply_local_zeroone(params, state, grads, denom)
+        return _apply_local_onebit(params, state, grads, denom)
+
+    def _apply_local_onebit(params, state, grads, denom):
         """All leaves fully local (manual over comm+tp). grads leading axis
         already stripped. Returns (params, state, gnorm)."""
         step = state["step"] + 1
@@ -284,26 +442,15 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
 
         def leaf_update(p, g, m, v, ew, es):
             g = g.astype(jnp.float32) / denom
-            nloc = int(np.prod(g.shape))  # LOCAL size (tp-manual region)
             use_comm = ew.shape[-1] > 1 and W > 1
             m_new = b1 * m + (1 - b1) * g
             if use_comm:
-                flat = m_new.ravel()
-                pad = ew.shape[-1] - nloc
-                flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-
-                def comp(args):
-                    f, w, s = args
-                    return compressed_allreduce(f, w, s, comm_axis)
-
-                def dense(args):
-                    f, w, s = args
-                    return lax.pmean(f, comm_axis), w, s
-
-                out, ew2, es2 = lax.cond(compressed_phase, comp, dense,
-                                         (flat, ew[0, 0], es[0, 0]))
-                m_avg = out[:nloc].reshape(g.shape)
-                ew2, es2 = ew2[None, None], es2[None, None]
+                m_avg, ew2, es2 = lax.cond(
+                    compressed_phase,
+                    lambda args: leaf_compressed_allreduce(*args),
+                    lambda args: (lax.pmean(args[0], comm_axis), args[1],
+                                  args[2]),
+                    (m_new, ew, es))
             else:
                 m_avg = lax.pmean(m_new, comm_axis) if W > 1 else m_new
                 ew2, es2 = ew, es
@@ -330,10 +477,7 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
         out = jax.tree_util.tree_map(
             leaf_update, params, grads, state["m"], state["v"], state["e_w"],
             state["e_s"])
-        gnorm_sq = sum(gnorm_sq_parts)
-        if "tp" in opt_manual:
-            gnorm_sq = lax.psum(gnorm_sq, "tp")  # scalar — negligible traffic
-        gnorm = jnp.sqrt(gnorm_sq)
+        gnorm = _finish_gnorm(sum(gnorm_sq_parts))
         split = lambda i: jax.tree_util.tree_map(
             lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
         return split(0), {"m": split(1), "v": split(2), "e_w": split(3),
@@ -359,6 +503,11 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
             "e_s": jax.tree_util.tree_map(lambda s: s, err_specs),
             "step": P(),
         }
+        if kind == "zerooneadam":
+            state_specs["u"] = jax.tree_util.tree_map(lambda s: s, in_p)
+            for k in ("var_interval", "var_counter", "local_interval",
+                      "local_counter", "lrs"):
+                state_specs[k] = P()
 
         def body(params, state, grads, denom):
             grads = jax.tree_util.tree_map(lambda g: g[0], grads)
@@ -394,6 +543,11 @@ def build_plan(model, topology, param_spec_tree, param_shapes, opt_name: str,
         "e_w": err_sh, "e_s": jax.tree_util.tree_map(lambda x: x, err_sh),
         "step": NamedSharding(mesh, P()),
     }
+    if kind == "zerooneadam":
+        state_sharding["u"] = jax.tree_util.tree_map(lambda x: x, psh)
+        for k in ("var_interval", "var_counter", "local_interval",
+                  "local_counter", "lrs"):
+            state_sharding[k] = NamedSharding(mesh, P())
     log_dist(f"1-bit optimizer {kind}: comm_axis={comm_axis} W={W} "
              f"freeze_step={freeze_step} var_freeze={var_freeze}")
     if schedule_fn is not None:

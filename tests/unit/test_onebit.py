@@ -181,3 +181,27 @@ def test_onebit_rejects_invalid_configs(eight_devices):
     from deepspeed_tpu.runtime.optimizers import build_optimizer
     with pytest.raises(ValueError, match="1-bit"):
         build_optimizer("OneBitAdam", {"lr": 1e-3})
+
+
+def test_zeroone_adam_schedules(eight_devices):
+    """0/1 Adam policy (zoadam.py): exponential variance-update intervals in
+    phase 1, local-step comm skipping with interval doubling (clipped) in
+    phase 2 — and training keeps converging across both phase boundaries."""
+    eng = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                        config=make_config("ZeroOneAdam", {"dp": 8},
+                                           var_freeze_step=4,
+                                           var_update_scaler=2,
+                                           local_step_scaler=3,
+                                           local_step_clipper=4))[0]
+    losses = run(eng, 12)
+    st = eng.opt_state
+    assert int(st["step"]) == 12
+    # phase 1 (steps 1-4): interval 1 doubles after var_update_scaler=2
+    # variance updates -> 2; then one more var step at step 4
+    assert int(st["var_interval"]) == 2 and int(st["var_counter"]) == 1
+    # phase 2 (steps 5-12): 8 frozen steps, interval doubles every 3,
+    # clipped at 4: 1 -> 2 (step 7) -> 4 (step 10)
+    assert int(st["local_interval"]) == 4 and int(st["local_counter"]) == 2
+    # the momentum accumulator exists and training is healthy end-to-end
+    assert "u" in st and np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
