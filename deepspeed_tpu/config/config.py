@@ -123,13 +123,66 @@ class OffloadOptimizerConfig(DSTpuConfigModel):
 
 
 class ZenFlowConfig(DSTpuConfigModel):
-    """``zero_optimization.zenflow`` (reference ``runtime/zenflow/``):
-    asynchronous host-offload updates that overlap the accelerator's next
-    step. Here ``overlap_step`` runs the whole host Adam step in a background
-    worker with 1-step bounded staleness (the reference's importance-based
-    top-k gradient split is not replicated — all grads take the async path)."""
+    """``zero_optimization.zenflow`` (reference ``runtime/zenflow/
+    zenflow_config.py``). Two mechanisms, composable with offload_optimizer:
 
-    overlap_step: bool = True
+    * ``overlap_step`` — the whole host Adam step runs on a background worker
+      with 1-step bounded staleness, overlapping the accelerator's next
+      fwd/bwd.
+    * ``topk_ratio > 0`` — the importance-based gradient split: the top-k
+      most important gradient columns update ON DEVICE every step via a
+      selective Adam; the rest accumulate (on device, one grad-sized buffer)
+      and flow through the offloaded host Adam only every ``update_interval``
+      steps. Columns are reselected every ``select_interval`` steps.
+      ``"auto"`` intervals resolve to update=4, select=4*update (the
+      reference's auto policy monitors gradient overlap per epoch; epochs are
+      not visible here, so auto is a fixed cadence)."""
+
+    overlap_step: bool = False
+    topk_ratio: float = 0.0          # 0 disables the selective split
+    select_strategy: str = "auto"    # "auto" | "step" ("epoch" not supported)
+    select_interval: Any = "auto"    # "auto" | int (steps)
+    update_interval: Any = "auto"    # "auto" | int (steps)
+    full_warm_up_rounds: int = 0     # initial steps with full dense updates
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not (0.0 <= self.topk_ratio <= 1.0):
+            raise ValueError("zenflow.topk_ratio must be in [0, 1]")
+        if self.select_strategy not in ("auto", "step"):
+            raise ValueError(
+                "zenflow.select_strategy: 'epoch' needs steps_per_epoch which "
+                "the engine does not track — use 'step' with select_interval "
+                "in steps, or 'auto'")
+        for f in ("select_interval", "update_interval"):
+            v = getattr(self, f)
+            if not (v == "auto" or (isinstance(v, int) and v >= 1)):
+                raise ValueError(f"zenflow.{f} must be 'auto' or a positive "
+                                 "integer")
+        if self.select_strategy == "step" and self.select_interval == "auto":
+            raise ValueError(
+                "zenflow.select_strategy='step' requires an explicit integer "
+                "select_interval (in steps)")
+        if self.topk_ratio > 0 and self.overlap_step:
+            raise ValueError(
+                "zenflow: overlap_step and the top-k selective split are "
+                "alternative overlap mechanisms — enable one, not both")
+        if self.topk_ratio == 0 and not self.overlap_step:
+            logger.warning(
+                "zero_optimization.zenflow is enabled but both mechanisms are "
+                "off (overlap_step=False, topk_ratio=0) — the block is a "
+                "no-op; set overlap_step=true or topk_ratio>0. NOTE: "
+                "overlap_step's default changed from true to false to match "
+                "the reference default.")
+        return self
+
+    def resolved_update_interval(self) -> int:
+        return 4 if self.update_interval == "auto" else int(self.update_interval)
+
+    def resolved_select_interval(self) -> int:
+        if self.select_interval == "auto":
+            return 4 * self.resolved_update_interval()
+        return int(self.select_interval)
 
 
 class ZeroConfig(DSTpuConfigModel):

@@ -213,6 +213,9 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------
     def state_dict(self) -> Dict[str, np.ndarray]:
+        return self._state_dict_base()
+
+    def _state_dict_base(self) -> Dict[str, np.ndarray]:
         assert self._pending is None, (
             "flush the async step (engine.step boundary) before checkpointing")
         out = {"step": np.int64(self.adam.step_count)}
@@ -242,3 +245,254 @@ class HostOffloadOptimizer:
                 getattr(self, kind)[name] = np.ascontiguousarray(val, np.float32)
         if self.swapper is not None:
             self.swapper.wait()
+
+
+# ---------------------------------------------------------------------------
+# ZenFlow importance-based top-k gradient split
+# ---------------------------------------------------------------------------
+
+class ZenFlowSelectiveOptimizer(HostOffloadOptimizer):
+    """ZenFlow's selective path (``runtime/zenflow/zenflow_stage_1_and_2.py``:
+    ``update_selected_channels`` :155 + ``ZenFlowSelectiveAdamW``): per 2-D+
+    leaf, the ``topk_ratio`` most important gradient COLUMNS (importance =
+    sum |g| per output column) update on the accelerator every step through a
+    selective Adam, while the remaining columns' gradients accumulate and go
+    through the offloaded host Adam only every ``update_interval`` steps.
+    Columns re-select every ``select_interval`` steps (selective moments
+    restart — the reference migrates them; documented divergence).
+
+    TPU adaptation: the unimportant-grad accumulator lives ON DEVICE (one
+    grad-sized HBM buffer), so off-boundary steps move zero bytes over the
+    host link; the reference accumulates on CPU because GPU memory is the
+    scarce resource there. Non-2D leaves (norms, biases — a rounding error of
+    the footprint) update on device every step.
+
+    Invariants between update boundaries:
+      * device params own the selected columns (+ all non-2D leaves),
+      * host masters own the unselected columns,
+    and the boundary step re-synchronizes both directions.
+    """
+
+    def __init__(self, params: Any, topk_ratio: float = 0.1,
+                 select_interval: int = 16, update_interval: int = 4,
+                 full_warm_up_rounds: int = 0, **kw):
+        assert 0.0 < topk_ratio <= 1.0
+        super().__init__(params, **kw)
+        self.topk_ratio = float(topk_ratio)
+        self.select_interval = int(select_interval)
+        self.update_interval = int(update_interval)
+        self.warmup = int(full_warm_up_rounds)
+        import jax.numpy as jnp
+
+        flat = dict(_leaf_paths(params))
+        # leaves with a splittable column axis; tiny trailing dims stay dense
+        self._sel_names = sorted(n for n, l in flat.items()
+                                 if l.ndim >= 2 and l.shape[-1] >= 8)
+        self._full_names = sorted(set(flat) - set(self._sel_names))
+        self._k = {n: max(1, int(round(self.topk_ratio * flat[n].shape[-1])))
+                   for n in self._sel_names}
+        # device state: selective + full moments, unimportant accumulator
+        self._idx = None          # name -> int32 [k] selected columns
+        self._msel = {n: jnp.zeros(flat[n].shape[:-1] + (self._k[n],),
+                                   jnp.float32) for n in self._sel_names}
+        self._vsel = jax.tree_util.tree_map(jnp.zeros_like, self._msel)
+        self._mfull = {n: jnp.zeros(flat[n].shape, jnp.float32)
+                       for n in self._full_names}
+        self._vfull = jax.tree_util.tree_map(jnp.zeros_like, self._mfull)
+        self._acc = {n: jnp.zeros(flat[n].shape, jnp.float32)
+                     for n in self._sel_names}
+        self._t_sel = 0           # selective-Adam step count (reset on select)
+        self._jit_select = jax.jit(self._select_impl)
+        self._jit_step = jax.jit(self._step_impl)
+        self._jit_merge = jax.jit(self._merge_impl)
+        log_dist(f"zenflow selective: topk_ratio={topk_ratio} "
+                 f"update_interval={update_interval} "
+                 f"select_interval={select_interval} "
+                 f"{len(self._sel_names)} split leaves, "
+                 f"{len(self._full_names)} dense leaves")
+
+    # ---- jitted device programs -------------------------------------
+    def _select_impl(self, grads):
+        import jax.numpy as jnp
+        from jax import lax
+
+        idx = {}
+        for n in self._sel_names:
+            g = grads[n].astype(jnp.float32)
+            imp = jnp.sum(jnp.abs(g), axis=tuple(range(g.ndim - 1)))
+            _, top = lax.top_k(imp, self._k[n])
+            idx[n] = jnp.sort(top).astype(jnp.int32)
+        return idx
+
+    def _step_impl(self, params, msel, vsel, mfull, vfull, acc, grads, idx,
+                   lr, t):
+        """One selective device step: Adam on selected columns + all dense
+        leaves; unimportant columns accumulate. Returns the updated trees and
+        the FULL gradient norm (for logging/clip parity with the host path)."""
+        import jax.numpy as jnp
+
+        b1, b2 = self.adam.betas
+        eps, wd = self.adam.eps, self.adam.weight_decay
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - b1 ** tf
+        bc2 = 1 - b2 ** tf
+        gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in grads.values())
+        gnorm = jnp.sqrt(gnorm_sq)
+        clip = self.gradient_clipping
+        scale = (jnp.minimum(1.0, clip / (gnorm + 1e-6)) if clip > 0
+                 else jnp.float32(1.0))
+        new_p, new_m, new_v, new_mf, new_vf, new_acc = (dict(params), {}, {},
+                                                        {}, {}, {})
+        for n in self._sel_names:
+            g = grads[n].astype(jnp.float32) * scale
+            gs = jnp.take(g, idx[n], axis=-1)
+            m = b1 * msel[n] + (1 - b1) * gs
+            v = b2 * vsel[n] + (1 - b2) * jnp.square(gs)
+            p_sel = jnp.take(params[n].astype(jnp.float32), idx[n], axis=-1)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p_sel
+            upd = (p_sel - lr * u).astype(params[n].dtype)
+            new_p[n] = params[n].at[..., idx[n]].set(upd)
+            new_m[n], new_v[n] = m, v
+            new_acc[n] = acc[n] + g.at[..., idx[n]].set(0.0)
+        for n in self._full_names:
+            g = grads[n].astype(jnp.float32) * scale
+            m = b1 * mfull[n] + (1 - b1) * g
+            v = b2 * vfull[n] + (1 - b2) * jnp.square(g)
+            pf = params[n].astype(jnp.float32)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * pf
+            new_p[n] = (pf - lr * u).astype(params[n].dtype)
+            new_mf[n], new_vf[n] = m, v
+        return new_p, new_m, new_v, new_mf, new_vf, new_acc, gnorm
+
+    def _merge_impl(self, params, masters, idx):
+        """Boundary upload: unselected columns <- host-updated master."""
+        import jax.numpy as jnp
+
+        out = dict(params)
+        for n in self._sel_names:
+            mask = jnp.zeros(params[n].shape[-1], bool).at[idx[n]].set(True)
+            out[n] = jnp.where(mask, params[n],
+                               masters[n].astype(params[n].dtype))
+        return out
+
+    # ---- the step ----------------------------------------------------
+    def step(self, grads: Any, params: Any, step_num: int):
+        import jax.numpy as jnp
+
+        if step_num < self.warmup:
+            return super().step(grads, params, step_num)
+        flat_g = dict(_leaf_paths(grads))
+        flat_p = dict(_leaf_paths(params))
+        if self._idx is None:
+            # first selective step: masters are in sync (constructor/warmup)
+            self._select(flat_g, step_num)
+        self._t_sel += 1
+        lr = (float(self.schedule_fn(step_num)) if self.schedule_fn
+              else self.base_lr)
+        out = self._jit_step(flat_p, self._msel, self._vsel, self._mfull,
+                             self._vfull, self._acc, flat_g, self._idx,
+                             jnp.float32(lr), jnp.int32(self._t_sel))
+        self._last_gnorm = float(out[-1])
+        if not np.isfinite(self._last_gnorm):
+            # skip BEFORE committing: no optimizer state absorbed the bad step
+            self._t_sel -= 1
+            return params, True
+        (new_p, self._msel, self._vsel, self._mfull, self._vfull,
+         self._acc) = out[:-1]
+        if (step_num + 1) % self.update_interval == 0:
+            new_p = self._boundary(new_p, flat_g, step_num)
+        treedef = jax.tree_util.tree_structure(params)
+        ordered = [new_p[n] for n, _ in _leaf_paths(params)]
+        return jax.tree_util.tree_unflatten(treedef, ordered), False
+
+    def _select(self, flat_g, step_num: int) -> None:
+        import jax.numpy as jnp
+
+        self._idx = self._jit_select(flat_g)
+        self._msel = jax.tree_util.tree_map(jnp.zeros_like, self._msel)
+        self._vsel = jax.tree_util.tree_map(jnp.zeros_like, self._vsel)
+        self._t_sel = 0
+        self._last_select = step_num
+
+    def _boundary(self, flat_p, flat_g, step_num):
+        """Apply the accumulated unimportant gradients through the host Adam,
+        re-synchronize masters <-> device params, and (only here, when both
+        sides are consistent) re-select columns when due — reselecting
+        mid-cycle would let the next merge revert device updates to columns
+        that were selected earlier in the cycle."""
+        import jax.numpy as jnp
+
+        # host Adam over the accumulated (summed) unimportant grads; the
+        # selected columns carry zero grad and are overwritten from the
+        # device below, so their host trajectory is irrelevant
+        host_grads = {n: np.ascontiguousarray(
+            np.asarray(jax.device_get(self._acc[n]), np.float32))
+            for n in self._sel_names}
+        lr = (float(self.schedule_fn(step_num)) if self.schedule_fn
+              else self.base_lr)
+        self.adam.step_count += 1
+        for n in self._sel_names:
+            if self.swapper is not None:  # nvme moments tier
+                m = self.swapper.swap_in(n + ".m")
+                v = self.swapper.swap_in(n + ".v")
+            else:
+                m, v = self.m[n], self.v[n]
+            self.adam.step(self.master[n].reshape(-1),
+                           host_grads[n].reshape(-1), m.reshape(-1),
+                           v.reshape(-1), lr=lr, increment=False)
+            if self.swapper is not None:
+                self.swapper.swap_out(n + ".m", m)
+                self.swapper.swap_out(n + ".v", v)
+        if self.swapper is not None:
+            self.swapper.wait()
+        masters_dev = {n: jax.device_put(
+            self.master[n].astype(np.float32),
+            flat_p[n].sharding) for n in self._sel_names}
+        merged = self._jit_merge(flat_p, masters_dev, self._idx)
+        # refresh masters so BOTH column sets are current on the host
+        for n in self._sel_names:
+            self.master[n] = np.ascontiguousarray(
+                np.asarray(jax.device_get(merged[n]), np.float32))
+        for n in self._full_names:
+            self.master[n] = np.ascontiguousarray(
+                np.asarray(jax.device_get(flat_p[n]), np.float32))
+        self._acc = jax.tree_util.tree_map(jnp.zeros_like, self._acc)
+        if step_num + 1 - getattr(self, "_last_select", 0) >= \
+                self.select_interval:
+            self._select(flat_g, step_num + 1)
+        return merged
+
+    # ---- checkpoint ---------------------------------------------------
+    def state_dict(self):
+        out = self._state_dict_base()
+        out["zf/t_sel"] = np.int64(self._t_sel)
+        out["zf/last_select"] = np.int64(getattr(self, "_last_select", 0))
+        for n in self._sel_names:
+            if self._idx is not None:
+                out["zf/idx/" + n] = np.asarray(self._idx[n])
+            out["zf/msel/" + n] = np.asarray(self._msel[n])
+            out["zf/vsel/" + n] = np.asarray(self._vsel[n])
+            out["zf/acc/" + n] = np.asarray(self._acc[n])
+        for n in self._full_names:
+            out["zf/mfull/" + n] = np.asarray(self._mfull[n])
+            out["zf/vfull/" + n] = np.asarray(self._vfull[n])
+        return out
+
+    def load_state_dict(self, sd):
+        import jax.numpy as jnp
+
+        zf = {k: v for k, v in sd.items() if k.startswith("zf/")}
+        super().load_state_dict({k: v for k, v in sd.items()
+                                 if not k.startswith("zf/")})
+        self._t_sel = int(zf.pop("zf/t_sel", 0))
+        self._last_select = int(zf.pop("zf/last_select", 0))
+        idx = {}
+        for key, val in zf.items():
+            _, kind, name = key.split("/", 2)
+            if kind == "idx":
+                idx[name] = jnp.asarray(val)
+            else:
+                store = getattr(self, "_" + kind)
+                store[name] = jnp.asarray(val)
+        self._idx = idx or None

@@ -165,9 +165,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     from jax.sharding import NamedSharding, PartitionSpec
 
     repl = NamedSharding(engine.mesh, PartitionSpec())
+    # host-offload engines keep the device opt_state empty ({}) while
+    # opt_sharding still describes the optax layout — the real optimizer
+    # state restores from host_optimizer.npz below
+    opt_target = ({} if getattr(engine, "_offload", None) is not None
+                  else abstract(engine.opt_state, engine.opt_sharding))
     target = {
         "params": abstract(engine.params, engine.param_sharding),
-        "opt_state": abstract(engine.opt_state, engine.opt_sharding),
+        "opt_state": opt_target,
         # explicit replicated sharding: restoring on a DIFFERENT device count
         # cannot reuse the sharding recorded in the file (elastic resume)
         "scaler": jax.tree_util.tree_map(

@@ -555,24 +555,35 @@ class DeepSpeedTpuEngine:
     def _configure_offload_optimizer(self, off, schedule_fn) -> None:
         """ZeRO-Offload/Infinity path (engine.py:1960 CPUAdam selection parity);
         ``zero_optimization.zenflow`` turns on the asynchronous overlap step."""
-        from deepspeed_tpu.offload import HostOffloadOptimizer
+        from deepspeed_tpu.offload import (HostOffloadOptimizer,
+                                           ZenFlowSelectiveOptimizer)
 
         zf = self.config.zero_optimization.zenflow
         overlap = bool(zf is not None and zf.overlap_step)
-        if overlap and self.fp16_enabled:
+        selective = bool(zf is not None and zf.topk_ratio > 0)
+        if (overlap or selective) and self.fp16_enabled:
             raise NotImplementedError(
-                "zenflow.overlap_step needs the overflow-skip decision at step "
+                "zenflow needs the overflow-skip decision at step "
                 "time; it does not compose with fp16 dynamic loss scaling "
                 "(use bf16)")
         p = dict(self.config.optimizer.params) if self.config.optimizer else {}
-        self._offload = HostOffloadOptimizer(
-            self.params,
+        common = dict(
             lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
             gradient_clipping=self.config.gradient_clipping,
             schedule_fn=schedule_fn,
             nvme_path=off.nvme_path if off.device == "nvme" else None,
-            aio_threads=off.buffer_count, overlap_step=overlap)
+            aio_threads=off.buffer_count)
+        if selective:
+            self._offload = ZenFlowSelectiveOptimizer(
+                self.params, topk_ratio=zf.topk_ratio,
+                select_interval=zf.resolved_select_interval(),
+                update_interval=zf.resolved_update_interval(),
+                full_warm_up_rounds=zf.full_warm_up_rounds, **common)
+        else:
+            self._offload = HostOffloadOptimizer(self.params,
+                                                 overlap_step=overlap,
+                                                 **common)
 
     def step(self, *args, **kwargs):
         """Optimizer step at the GA boundary — engine.py:3241."""

@@ -188,3 +188,164 @@ class TestOffloadEngine:
             eng, *_ = ds.initialize(model=model, config=cfg)
             losses[mode] = self._train(eng, steps=3)
         np.testing.assert_allclose(losses["cpu"], losses["jit"], rtol=5e-3)
+
+
+class TestZenFlowSelective:
+    """Importance-based top-k gradient split (zenflow_stage_1_and_2.py:155):
+    selected columns update on device every step, the rest only through the
+    host Adam at update boundaries."""
+
+    def test_split_invariants(self):
+        """Off-boundary steps change ONLY the selected columns (+ dense
+        leaves); the boundary step applies the accumulated host update to the
+        unselected columns."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.offload import ZenFlowSelectiveOptimizer
+
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(4, 16)), jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=(16,)), jnp.float32)}
+        # constant grads with a clear importance ranking on the last 4 cols
+        g = np.ones((4, 16), np.float32) * 0.01
+        g[:, 12:] = 1.0
+        grads = {"w": jnp.asarray(g), "b": jnp.ones((16,), jnp.float32)}
+        opt = ZenFlowSelectiveOptimizer(
+            params, topk_ratio=0.25, select_interval=8, update_interval=3,
+            lr=1e-2)
+        sel = None
+        p = params
+        for step in range(3):
+            prev = jax.tree_util.tree_map(np.asarray, p)
+            p, skipped = opt.step(grads, p, step)
+            assert not skipped
+            if sel is None:
+                sel = np.asarray(opt._idx["w"])
+                np.testing.assert_array_equal(sel, [12, 13, 14, 15])
+            unsel = np.setdiff1d(np.arange(16), sel)
+            cur = jax.tree_util.tree_map(np.asarray, p)
+            # selected columns and the dense 1-D leaf move EVERY step
+            assert np.abs(cur["w"][:, sel] - prev["w"][:, sel]).max() > 0
+            assert np.abs(cur["b"] - prev["b"]).max() > 0
+            if step < 2:  # off-boundary: unselected columns are frozen
+                np.testing.assert_array_equal(cur["w"][:, unsel],
+                                              prev["w"][:, unsel])
+            else:  # boundary (step+1) % 3 == 0: host update lands
+                assert np.abs(cur["w"][:, unsel] - prev["w"][:, unsel]).max() > 0
+        # masters mirror device params after the boundary
+        np.testing.assert_allclose(opt.master["w"], np.asarray(p["w"]),
+                                   rtol=1e-6)
+
+    def test_reselection_and_state_dict(self):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.offload import ZenFlowSelectiveOptimizer
+
+        rng = np.random.default_rng(1)
+        params = {"w": jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)}
+        opt = ZenFlowSelectiveOptimizer(params, topk_ratio=0.25,
+                                        select_interval=2, update_interval=2,
+                                        lr=1e-3)
+        p = params
+        for step in range(4):
+            g = {"w": jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)}
+            p, _ = opt.step(g, p, step)
+        sd = opt.state_dict()
+        assert any(k.startswith("zf/idx/") for k in sd)
+        opt2 = ZenFlowSelectiveOptimizer(params, topk_ratio=0.25,
+                                         select_interval=2, update_interval=2,
+                                         lr=1e-3)
+        opt2.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(opt2._idx["w"]),
+                                      np.asarray(opt._idx["w"]))
+        np.testing.assert_allclose(np.asarray(opt2._msel["w"]),
+                                   np.asarray(opt._msel["w"]))
+
+    def test_engine_e2e_converges(self, eight_devices):
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu"},
+                "zenflow": {"topk_ratio": 0.2, "update_interval": 2,
+                            "select_interval": 4, "full_warm_up_rounds": 1},
+            },
+            "mesh": {"fsdp": 8},
+            "steps_per_print": 100,
+        }
+        from deepspeed_tpu.offload import ZenFlowSelectiveOptimizer
+
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=cfg)
+        assert isinstance(eng._offload, ZenFlowSelectiveOptimizer)
+        fixed = {"input_ids": np.random.default_rng(0).integers(
+            0, 256, (2 * eng.topology.dp_world_size, 16))}
+        losses = []
+        for _ in range(8):
+            loss = eng.forward(fixed)
+            eng.backward(loss)
+            eng.step()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_overlap_and_topk_mutually_exclusive(self):
+        import pytest
+
+        from deepspeed_tpu.config import from_config
+
+        with pytest.raises(Exception):
+            from_config({"train_micro_batch_size_per_gpu": 1,
+                         "zero_optimization": {
+                             "offload_optimizer": {"device": "cpu"},
+                             "zenflow": {"topk_ratio": 0.1,
+                                         "overlap_step": True}}})
+
+    def test_nvme_moments_tier(self, tmp_path):
+        """topk split composes with the NVMe moments tier: boundary host Adam
+        swaps moments in/out instead of reading the (empty) RAM dicts."""
+        import os
+
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.offload import ZenFlowSelectiveOptimizer
+
+        rng = np.random.default_rng(2)
+        params = {"w": jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)}
+        opt = ZenFlowSelectiveOptimizer(
+            params, topk_ratio=0.25, select_interval=8, update_interval=2,
+            lr=1e-2, nvme_path=str(tmp_path))
+        p = params
+        g = {"w": jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)}
+        for step in range(4):
+            p, skipped = opt.step(g, p, step)
+            assert not skipped
+        swp = os.path.join(str(tmp_path), "opt_states")
+        assert any(f.endswith(".swp") for f in os.listdir(swp))
+        assert np.isfinite(np.asarray(p["w"])).all()
+
+    def test_nonfinite_grad_skips_cleanly(self):
+        """A NaN step must leave every piece of optimizer state untouched."""
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.offload import ZenFlowSelectiveOptimizer
+
+        rng = np.random.default_rng(3)
+        params = {"w": jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)}
+        opt = ZenFlowSelectiveOptimizer(params, topk_ratio=0.25,
+                                        select_interval=8, update_interval=4,
+                                        lr=1e-2)
+        g_ok = {"w": jnp.ones((4, 16), jnp.float32)}
+        p, _ = opt.step(g_ok, params, 0)
+        m_before = np.asarray(opt._msel["w"]).copy()
+        acc_before = np.asarray(opt._acc["w"]).copy()
+        g_bad = {"w": jnp.full((4, 16), jnp.nan, jnp.float32)}
+        p2, skipped = opt.step(g_bad, p, 1)
+        assert skipped
+        np.testing.assert_array_equal(np.asarray(opt._msel["w"]), m_before)
+        np.testing.assert_array_equal(np.asarray(opt._acc["w"]), acc_before)
+        assert np.isfinite(np.asarray(p2["w"])).all()
+        # and recovery works
+        p3, skipped = opt.step(g_ok, p2, 2)
+        assert not skipped and np.isfinite(np.asarray(p3["w"])).all()
