@@ -60,8 +60,44 @@ def init_distributed(dist_backend: str = "xla",
     coordinator = (init_method or os.environ.get("DSTPU_COORDINATOR", "")).replace("tcp://", "")
     if rank < 0:
         rank = int(os.environ.get("DSTPU_RANK", -1))
+    # scheduler-native env discovery (reference mpi_discovery comm.py:861) is
+    # GATED: either the dstpu launcher set up rendezvous (coordinator present)
+    # or the caller opted in with auto_mpi_discovery — bare scheduler env
+    # (e.g. N independent experiments inside one srun allocation) must NOT
+    # trigger a rendezvous
+    discover = bool(coordinator) or auto_mpi_discovery
+    if rank < 0 and discover:
+        for var in ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                    "PMIX_RANK"):
+            if var in os.environ:
+                rank = int(os.environ[var])
+                break
+    if rank < 0 and discover and os.environ.get("DSTPU_HOSTS"):
+        # pdsh path: every host got the identical command; derive the rank
+        # from this host's position in the fan-out list
+        import socket
+
+        names = os.environ["DSTPU_HOSTS"].split(",")
+        me = socket.gethostname()
+        cands = [i for i, h in enumerate(names)
+                 if h == me or h.split(".")[0] == me.split(".")[0]]
+        if len(cands) == 1:
+            rank = cands[0]
     if world_size < 0:
         world_size = int(os.environ.get("DSTPU_WORLD_SIZE", -1))
+    if world_size < 0 and discover:
+        for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+            if var in os.environ:
+                world_size = int(os.environ[var])
+                break
+    if coordinator and world_size > 1 and rank < 0:
+        raise RuntimeError(
+            "multi-host launch: could not determine this process's rank — "
+            "DSTPU_RANK and scheduler env (SLURM_PROCID/OMPI_COMM_WORLD_RANK/"
+            "PMI_RANK) are absent and the hostname did not match exactly one "
+            f"entry of DSTPU_HOSTS={os.environ.get('DSTPU_HOSTS', '')!r}. "
+            "Set DSTPU_RANK explicitly (hostfiles with IPs cannot be matched "
+            "by hostname).")
     if coordinator or world_size > 1:
         kw: dict = {}
         if coordinator:

@@ -100,23 +100,23 @@ def _spawn_local(args, env_base) -> int:
 
 def _spawn_ssh(args, hosts: Dict[str, int], env_base) -> int:
     """Multi-host ssh fan-out (multinode_runner.py PDSH-equivalent over plain ssh)."""
+    from deepspeed_tpu.launcher.multinode_runner import (EXPORT_PREFIXES,
+                                                         remote_shell_line)
+
     ordered = list(hosts)
     world = len(ordered)
     master = ordered[0]
     coordinator = f"{master}:{args.master_port}"
     exports = {k: v for k, v in env_base.items()
-               if k.startswith(("DSTPU_", "JAX_", "XLA_", "TPU_", "PYTHONPATH"))}
+               if k.startswith(EXPORT_PREFIXES)}
     procs = []
     for rank, host in enumerate(ordered):
-        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in {
+        remote = remote_shell_line(args, {
             **exports,
             "DSTPU_COORDINATOR": coordinator,
             "DSTPU_RANK": str(rank),
             "DSTPU_WORLD_SIZE": str(world),
-        }.items())
-        remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " \
-                 f"{shlex.quote(sys.executable)} {shlex.quote(args.script)} " \
-                 + " ".join(shlex.quote(a) for a in args.script_args)
+        })
         procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
                                        host, remote]))
     code = 0
@@ -139,6 +139,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="local processes (CPU-mesh testing)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "slurm", "mpich",
+                                 "impi"],
+                        help="multi-node transport (multinode_runner.py "
+                             "parity); ssh = built-in fan-out")
+    parser.add_argument("--slurm_comment", default="")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -147,7 +153,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.hostfile:
         hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
         if len(hosts) > 1 or args.force_multi:
+            if args.launcher != "ssh":
+                from deepspeed_tpu.launcher.multinode_runner import RUNNERS
+
+                runner = RUNNERS[args.launcher](args)
+                if not runner.backend_exists():
+                    raise RuntimeError(
+                        f"--launcher {args.launcher}: transport binary not "
+                        "found on this host")
+                cmd = runner.get_cmd(env, hosts)
+                return subprocess.call(cmd, env=runner.get_env(env, hosts))
             return _spawn_ssh(args, hosts, env)
+        if args.launcher != "ssh":
+            raise ValueError(
+                f"--launcher {args.launcher} given but the (filtered) "
+                "hostfile has a single host and --force_multi is unset — "
+                "the script would silently run locally; add --force_multi "
+                "to fan out to that one host")
     return _spawn_local(args, env)
 
 

@@ -37,6 +37,63 @@ class TestLauncher:
         with pytest.raises(ValueError):
             filter_hosts(hosts, include="zzz")
 
+    @pytest.mark.parametrize("name", ["pdsh", "openmpi", "slurm", "mpich",
+                                      "impi"])
+    def test_multinode_runner_cmds(self, name):
+        """Reference multinode_runner.py parity: each transport builds one
+        command that fans the script out with the rendezvous env (pure-unit,
+        same as tests/unit/launcher's multinode cmd tests)."""
+        import argparse
+
+        from deepspeed_tpu.launcher.multinode_runner import RUNNERS
+
+        args = argparse.Namespace(script="train.py", script_args=["--x", "1"],
+                                  master_port=29500, slurm_comment="")
+        hosts = {"h0": 1, "h1": 1}
+        runner = RUNNERS[name](args)
+        base_env = {"PYTHONPATH": "/repo", "HOME": "/root"}
+        cmd = runner.get_cmd(base_env, hosts)
+        joined = " ".join(cmd)
+        assert cmd[0] in ("pdsh", "mpirun", "srun", "mpiexec")
+        assert "train.py" in joined and "--x" in joined
+        if name == "slurm":
+            # slurm forwards rendezvous via the srun process env (inline
+            # --export K=V cannot carry comma-valued DSTPU_HOSTS) and pins
+            # the coordinator to the sorted-first host (= SLURM task 0)
+            env = runner.get_env(base_env, hosts)
+            assert env["DSTPU_COORDINATOR"] == "h0:29500"
+            assert env["DSTPU_HOSTS"] == "h0,h1"
+            assert "--ntasks-per-node" in cmd and "--export" in cmd
+            assert "ALL" in cmd and "DSTPU_HOSTS" not in joined
+        else:
+            assert "DSTPU_COORDINATOR" in joined and "h0:29500" in joined
+            assert "DSTPU_WORLD_SIZE" in joined
+            assert "PYTHONPATH" in joined     # exported prefix forwarded
+            assert "HOME" not in joined       # non-exported env NOT forwarded
+        if name in ("openmpi", "mpich", "impi"):
+            assert "2" in cmd  # one rank per host
+
+    def test_scheduler_rank_discovery(self, monkeypatch):
+        """init_distributed reads scheduler-native rank envs (SLURM/OMPI/PMI)
+        when the launcher's DSTPU_RANK is absent."""
+        import deepspeed_tpu.comm.comm as c
+
+        captured = {}
+
+        def fake_init(**kw):
+            captured.update(kw)
+
+        monkeypatch.setattr(c.jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(c, "_initialized", False)
+        monkeypatch.setenv("DSTPU_COORDINATOR", "h0:29500")
+        monkeypatch.setenv("DSTPU_WORLD_SIZE", "4")
+        monkeypatch.setenv("SLURM_PROCID", "3")
+        monkeypatch.delenv("DSTPU_RANK", raising=False)
+        c.init_distributed()
+        assert captured == {"coordinator_address": "h0:29500",
+                            "process_id": 3, "num_processes": 4}
+        monkeypatch.setattr(c, "_initialized", True)
+
 
 class TestElasticity:
     def test_compatible_chips(self):
