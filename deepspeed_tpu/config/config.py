@@ -446,6 +446,12 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
 
     gradient_clipping: float = 0.0
     steps_per_print: int = 10
+    # engine.py:1346 sanity_checks parity: cross-process config digest,
+    # param integrity/placement at startup, first-batch agreement.
+    # Per-host-sharded data loaders legitimately feed different batches —
+    # disable only that check with sanity_check_batches=false.
+    sanity_checks: bool = False
+    sanity_check_batches: bool = True
     wall_clock_breakdown: bool = False
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0

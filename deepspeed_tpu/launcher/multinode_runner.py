@@ -118,6 +118,15 @@ class OpenMPIRunner(MultiNodeRunner):
         return cmd + _script_cmd(self.args)
 
 
+def _natural_key(host: str):
+    """SLURM hostlist ordering: numeric suffixes sort numerically
+    (node2 < node10), unlike Python's lexicographic sort."""
+    import re
+
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", host)]
+
+
 class SlurmRunner(MultiNodeRunner):
     """srun (reference ``SlurmRunner``): SLURM owns placement and rank
     (SLURM_PROCID). Rendezvous env rides the srun process's own environment
@@ -132,7 +141,7 @@ class SlurmRunner(MultiNodeRunner):
         return shutil.which("srun") is not None
 
     def _rendezvous(self, hosts):
-        ordered = sorted(hosts)
+        ordered = sorted(hosts, key=_natural_key)
         return {
             "DSTPU_COORDINATOR": f"{ordered[0]}:{self.args.master_port}",
             "DSTPU_WORLD_SIZE": str(len(hosts)),
@@ -145,7 +154,8 @@ class SlurmRunner(MultiNodeRunner):
     def get_cmd(self, environment, hosts):
         cmd = ["srun", "--nodes", str(len(hosts)),
                "--ntasks", str(len(hosts)), "--ntasks-per-node", "1",
-               "--nodelist", ",".join(sorted(hosts)), "--export", "ALL"]
+               "--nodelist", ",".join(sorted(hosts, key=_natural_key)),
+               "--export", "ALL"]
         if getattr(self.args, "slurm_comment", ""):
             cmd += ["--comment", self.args.slurm_comment]
         return cmd + _script_cmd(self.args)

@@ -150,6 +150,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     env = dict(os.environ)
+    if args.launcher != "ssh" and not args.hostfile:
+        raise ValueError(
+            f"--launcher {args.launcher} requires --hostfile (the transport "
+            "fans the script out to the hostfile's hosts); without it the "
+            "script would silently run locally")
     if args.hostfile:
         hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
         if len(hosts) > 1 or args.force_multi:

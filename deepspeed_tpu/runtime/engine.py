@@ -229,6 +229,12 @@ class DeepSpeedTpuEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data,
                                                          collate_fn=collate_fn)
+        self._first_batch_checked = not (config.sanity_checks
+                                         and config.sanity_check_batches)
+        if config.sanity_checks:
+            from deepspeed_tpu.runtime.sanity import run_startup_checks
+
+            run_startup_checks(self)
         log_dist(f"engine ready: {self._world_params/1e6:.1f}M params, "
                  f"zero_stage={self.zero_stage}, mesh={self.topology}, "
                  f"batch={config.train_batch_size} (micro={config.train_micro_batch_size_per_gpu}"
@@ -523,6 +529,11 @@ class DeepSpeedTpuEngine:
             self._update_random_ltd()  # only at accumulation boundaries
         batch = self._apply_curriculum(batch)
         batch = self._inject_ltd_seed(batch)
+        if not self._first_batch_checked:
+            from deepspeed_tpu.runtime.sanity import check_batch_consistency
+
+            check_batch_consistency(batch)  # engine.py:641 broadcast check
+            self._first_batch_checked = True
         batch = self._put_batch(batch)
         p_in = (self._hpz_secondary
                 if self._zpp is not None and self._zpp.uses_secondary

@@ -1,0 +1,103 @@
+"""Engine sanity checks (SURVEY §5.2; reference: the ``sanity_checks``
+config consumed at ``engine.py:1346``, the cross-rank config asserts
+``assert_ints_same_as_other_ranks`` (zero/utils, used from
+``partition_parameters.py:29``), and the dataloader same-across-ranks check
+at ``engine.py:641``).
+
+TPU translation: there are no autograd-hook races to lock against (XLA owns
+scheduling), so what remains meaningful is cross-HOST consistency (a
+mis-deployed config or data pipeline trains garbage silently on a pod) and
+state integrity:
+
+* config digest identical on every process,
+* parameter tree is finite and placed exactly as ``param_sharding`` says,
+* the first training batch agrees across processes (replicated-loader
+  deployments; per-host-sharded loaders opt out via the
+  ``sanity_check_batches: false`` config flag).
+
+Enabled by the top-level ``sanity_checks`` config flag; each check is also
+callable directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm.comm import assert_same_across_processes
+from deepspeed_tpu.utils.logging import log_dist
+
+__all__ = ["check_config_consistency", "check_param_integrity",
+           "check_param_placement", "check_batch_consistency",
+           "run_startup_checks"]
+
+
+def _digest64(payload: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(payload).digest()[:8], "big",
+                          signed=False) >> 1  # fits int64
+
+
+def check_config_consistency(engine) -> None:
+    """Every process must run the SAME resolved config (reference
+    assert_ints_same_as_other_ranks on shard counts; here the whole config)."""
+    payload = json.dumps(engine.config.model_dump(mode="json"),
+                         sort_keys=True, default=str).encode()
+    assert_same_across_processes(np.int64(_digest64(payload)),
+                                 "config digest")
+
+
+def check_param_integrity(engine) -> None:
+    """Raise on non-finite parameter leaves (a corrupted checkpoint or
+    diverged restore trains NaN silently); integer leaves are skipped."""
+    flat = jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    bad = []
+    leaves = [leaf for _, leaf in flat]
+    # one fused jit pass: a scalar per leaf, fetched together
+    finite = jax.jit(lambda ls: [jnp.all(jnp.isfinite(leaf))
+                                 if jnp.issubdtype(leaf.dtype, jnp.floating)
+                                 else jnp.asarray(True)
+                                 for leaf in ls])(leaves)
+    for (kp, _), ok in zip(flat, finite):
+        if not bool(ok):
+            bad.append(jax.tree_util.keystr(kp))
+    if bad:
+        raise RuntimeError(f"non-finite parameters in {len(bad)} leaves "
+                           f"(first 5): {bad[:5]}")
+
+
+def check_param_placement(engine) -> None:
+    """Actual leaf shardings must match the engine's declared
+    ``param_sharding`` — a silently replicated leaf defeats ZeRO memory math."""
+    def cmp(leaf, expected):
+        got = getattr(leaf, "sharding", None)
+        if got is not None and expected is not None and got != expected:
+            raise RuntimeError(
+                f"parameter placed as {got.spec} but the engine declared "
+                f"{expected.spec}")
+
+    jax.tree_util.tree_map(cmp, engine.params, engine.param_sharding)
+
+
+def check_batch_consistency(batch: Any) -> None:
+    """First-batch agreement across processes (engine.py:641 broadcast check):
+    with replicated loaders every host must feed identical data, or the psum'd
+    gradients silently average different datasets."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    payload = b"".join(np.ascontiguousarray(np.asarray(x)).tobytes()
+                       for x in leaves)
+    assert_same_across_processes(np.int64(_digest64(payload)),
+                                 "training batch digest")
+
+
+def run_startup_checks(engine) -> None:
+    """The engine-construction sanity pass (``sanity_checks: true``)."""
+    check_config_consistency(engine)
+    check_param_integrity(engine)
+    check_param_placement(engine)
+    log_dist("sanity checks passed: config digest, param integrity, "
+             "param placement")
