@@ -294,9 +294,19 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
         new_cache = {"k": ck, "v": cv, "pos": pos + T}
         return attn_out_proj(out, w, cfg), new_cache
     if cfg.sliding_window is not None:
-        # the pallas flash/ring kernels have no window support: windowed
-        # families (mistral/qwen2) route through the masked XLA path
-        out = xla_attention(q, k, v, causal=True, window=cfg.sliding_window)
+        # windowed families (mistral/qwen2): the flash kernel takes the
+        # window natively (block-skipping); impls without window support
+        # (ring/ulysses SP wrappers) fall back to the masked XLA path
+        import inspect
+
+        params = inspect.signature(attn_fn).parameters
+        takes_window = ("window" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()))
+        if takes_window:
+            out = attn_fn(q, k, v, causal=True, window=cfg.sliding_window)
+        else:
+            out = xla_attention(q, k, v, causal=True,
+                                window=cfg.sliding_window)
     else:
         out = attn_fn(q, k, v, causal=True)
     o = attn_out_proj(out, w, cfg)

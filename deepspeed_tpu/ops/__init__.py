@@ -89,10 +89,12 @@ def _register_model_attention() -> None:
     from deepspeed_tpu.models import transformer as tfm
     from deepspeed_tpu.ops.flash_attention import flash_attention
 
-    def flash_or_xla(q, k, v, *, causal=True, segment_ids=None):
+    def flash_or_xla(q, k, v, *, causal=True, segment_ids=None, window=None):
         if OpBuilder.on_tpu():
-            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
-        return tfm.xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids, window=window)
+        return tfm.xla_attention(q, k, v, causal=causal,
+                                 segment_ids=segment_ids, window=window)
 
     tfm.register_attention_impl("flash", flash_or_xla)
     tfm.register_attention_impl("flash_pallas", flash_attention)  # force kernel (tests)

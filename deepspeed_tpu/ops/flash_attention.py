@@ -48,8 +48,22 @@ def _on_tpu() -> bool:
 # forward kernel
 # ---------------------------------------------------------------------------
 
+def _block_live(causal, window, q_start, k_start, block_q, block_k):
+    """Per-tile liveness predicate for ``pl.when`` (q_start/k_start are traced
+    program-id products): dead when entirely above the causal diagonal or
+    entirely older than the sliding window."""
+    live = True
+    if causal:
+        live = k_start <= q_start + block_q - 1
+    if window is not None:
+        in_win = k_start + block_k - 1 >= q_start - (window - 1)
+        live = in_win if live is True else jnp.logical_and(live, in_win)
+    return live
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, block_q: int, block_k: int):
+                scale: float, causal: bool, window, block_q: int,
+                block_k: int):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -61,8 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     q_start = iq * block_q
     k_start = ik * block_k
-    # block is live unless it is entirely above the diagonal
-    live = (not causal) or (k_start <= q_start + block_q - 1)
+    live = _block_live(causal, window, q_start, k_start, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -74,13 +87,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v = v_ref[0, 0]                      # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
+        if causal or window is not None:
             # rows+q_start >= cols+k_start  ⟺  rows-cols >= k_start-q_start:
             # the iota difference is block-invariant, only the scalar threshold
             # moves, which keeps the per-block VPU mask work to compare+select
             diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                     - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-            s = jnp.where(diff >= k_start - q_start, s, NEG_INF)
+            keep = (diff >= k_start - q_start) if causal else True
+            if window is not None:  # mistral/qwen2 sliding window
+                keep = keep & (diff <= window - 1 + k_start - q_start)
+            s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -100,14 +116,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = m_scr[:, :1] + jnp.log(denom)
 
 
-def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd_pallas(q, k, v, *, scale, causal, window, block_q, block_k,
+                interpret):
     B, H, T, d = q.shape
     S, K = k.shape[2], k.shape[1]
     rep = H // K
     nq, nk = T // block_q, S // block_k
     grid = (B, H, nq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               window=window, block_q=block_q, block_k=block_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -138,8 +155,19 @@ def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k, interpret):
 # backward kernels
 # ---------------------------------------------------------------------------
 
+def _bwd_mask(s, causal, window, q_start, k_start):
+    if not causal and window is None:
+        return s
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+    keep = (rows >= cols) if causal else True
+    if window is not None:
+        keep = keep & (rows - cols <= window - 1)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                   scale, causal, block_q, block_k):
+                   scale, causal, window, block_q, block_k):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     q_start, k_start = iq * block_q, ik * block_k
@@ -148,7 +176,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (not causal) or (k_start <= q_start + block_q - 1)
+    live = _block_live(causal, window, q_start, k_start, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -160,10 +188,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         delta = delta_ref[0, 0]               # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _bwd_mask(s, causal, window, q_start, k_start)
         p = jnp.exp(s - lse)                  # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -178,7 +203,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, causal, block_q, block_k):
+                    scale, causal, window, block_q, block_k):
     ik, iq = pl.program_id(2), pl.program_id(3)  # kv-blocks outer, q-blocks inner
     nq = pl.num_programs(3)
     q_start, k_start = iq * block_q, ik * block_k
@@ -188,7 +213,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = (not causal) or (k_start <= q_start + block_q - 1)
+    live = _block_live(causal, window, q_start, k_start, block_q, block_k)
 
     @pl.when(live)
     def _compute():
@@ -200,10 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _bwd_mask(s, causal, window, q_start, k_start)
         p = jnp.exp(s - lse)                   # [bq, bk]
         pc = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(
@@ -220,7 +242,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, block_q, block_k, interpret):
+def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, window, block_q,
+                block_k, interpret):
     B, H, T, d = q.shape
     S, K = k.shape[2], k.shape[1]
     rep = H // K
@@ -230,7 +253,7 @@ def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, block_q, block_k, inter
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -249,7 +272,7 @@ def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, block_q, block_k, inter
     # dk/dv accumulate over q blocks, per Q-head; GQA-sum folded after.
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
@@ -293,24 +316,25 @@ def _pick_block(n: int, preferred: int) -> int:
     return max(b, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, window, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
     scale = 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+    out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal, window=window,
                            block_q=block_q, block_k=block_k, interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, window, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
     scale = 1.0 / math.sqrt(q.shape[-1])
     dq, dk, dv = _bwd_pallas(q, k, v, out, lse, do, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k, interpret=interpret)
+                             window=window, block_q=block_q, block_k=block_k,
+                             interpret=interpret)
     return dq, dk, dv
 
 
@@ -318,23 +342,41 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
-                    segment_ids=None, block_q: int = DEFAULT_BLOCK_Q,
+                    segment_ids=None, window: Optional[int] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Flash attention over model-layout tensors q[B,T,H,d], k/v[B,S,K,d]."""
+    """Flash attention over model-layout tensors q[B,T,H,d], k/v[B,S,K,d].
+
+    ``window`` masks keys more than ``window-1`` positions behind each query
+    (mistral/qwen2 sliding-window attention); fully-out-of-window KV blocks
+    are skipped, so compute scales with ``T*window`` instead of ``T*S``."""
     if segment_ids is not None:
         from deepspeed_tpu.models.transformer import xla_attention
 
-        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                             window=window)
+    T, S = q.shape[1], k.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window implies causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if T != S:
+            # the block mask is start-aligned (row==col on the diagonal);
+            # an S != T cache layout needs the end-aligned offset the dense
+            # decode path applies — route those through the cache attention
+            raise ValueError(
+                f"windowed flash attention requires T == S (got T={T}, "
+                f"S={S}); use the KV-cache decode path for ragged shapes")
     if interpret is None:
         interpret = not _on_tpu()
-    T, S = q.shape[1], k.shape[1]
     bq = _pick_block(T, block_q)
     bk = _pick_block(S, block_k)
     qt = q.transpose(0, 2, 1, 3)  # [B, H, T, d]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, bq, bk, interpret)
+    out = _flash(qt, kt, vt, causal, window, bq, bk, interpret)
     out = out.transpose(0, 2, 1, 3)
     # Named so remat policies can pin the kernel's output: attention is
     # VPU-bound (~5-10% MFU ceiling at trainable seq lens on v5e) and must
