@@ -736,11 +736,6 @@ class TransformerLM:
                                                        paged_update)
 
         cfg = self.cfg
-        if cfg.sliding_window is not None:
-            raise NotImplementedError(
-                "paged attention has no sliding-window mask yet — serving a "
-                "windowed family (mistral/qwen2) through the paged path would "
-                "silently attend beyond the window; use the dense KV cache")
         dt = jnp.dtype(cfg.dtype)
         B, t = input_ids.shape
         positions = pos[:, None] + jnp.arange(t, dtype=pos.dtype)[None, :]
@@ -760,7 +755,8 @@ class TransformerLM:
                 nk = paged_update(kp, k, block_tables, pos, valid)
                 nv = paged_update(vp, v, block_tables, pos, valid)
                 new_kv["k"], new_kv["v"] = nk, nv
-                return paged_attention_tp(q, nk, nv, block_tables, pos)
+                return paged_attention_tp(q, nk, nv, block_tables, pos,
+                                          window=cfg.sliding_window)
 
             h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn)
             return h, (new_kv["k"], new_kv["v"])

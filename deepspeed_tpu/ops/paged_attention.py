@@ -84,7 +84,7 @@ def paged_update(pool: jax.Array, new: jax.Array, block_tables: jax.Array,
 
 def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float, block_size: int,
-                  t: int):
+                  t: int, window):
     b, h, ib = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nb = pl.num_programs(2)
 
@@ -96,8 +96,12 @@ def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     pos = pos_ref[b]
     # a block is live if any of its cache positions is visible to the newest
-    # query row (global position pos + t - 1)
+    # query row (global position pos + t - 1) — and, with a sliding window,
+    # not entirely older than the oldest query row's window
     live = ib * block_size <= pos + t - 1
+    if window is not None:
+        live = jnp.logical_and(
+            live, ib * block_size + block_size - 1 >= pos - (window - 1))
 
     @pl.when(live)
     def _compute():
@@ -108,7 +112,10 @@ def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                                 preferred_element_type=jnp.float32) * scale  # [t, bs]
         row_pos = pos + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         col_pos = ib * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col_pos <= row_pos, s, NEG_INF)
+        keep = col_pos <= row_pos
+        if window is not None:  # mistral/qwen2 sliding window
+            keep = keep & (col_pos > row_pos - window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -126,7 +133,8 @@ def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
-def _paged_pallas(q, k_pool, v_pool, block_tables, pos, *, interpret: bool):
+def _paged_pallas(q, k_pool, v_pool, block_tables, pos, *, window,
+                  interpret: bool):
     """q: [B, H, t, d]; pools: [nb+1, bs, K, d]; tables: [B, nb_max]; pos: [B]."""
     B, H, t, d = q.shape
     bs, K = k_pool.shape[1], k_pool.shape[2]
@@ -138,16 +146,29 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, pos, *, interpret: bool):
     kp = k_pool.transpose(0, 2, 1, 3).reshape(-1, bs, d)  # [(nb+1)*K, bs, d]
     vp = v_pool.transpose(0, 2, 1, 3).reshape(-1, bs, d)
 
-    kernel = functools.partial(_paged_kernel, scale=scale, block_size=bs, t=t)
+    kernel = functools.partial(_paged_kernel, scale=scale, block_size=bs, t=t,
+                               window=window)
+
+    def kv_index(b, h, ib, bt, ps):
+        # clamp dead grid steps (beyond the causal frontier, or older than
+        # the sliding window) onto the nearest live logical block: Pallas
+        # elides the re-fetch of an unchanged block, so out-of-range blocks
+        # cost no DMA — decode bandwidth scales with min(pos, window), not
+        # with nb_max
+        lo = 0
+        if window is not None:
+            lo = jnp.maximum((ps[b] - (window - 1)) // bs, 0)
+        hi = jnp.clip((ps[b] + t - 1) // bs, 0, nb_max - 1)
+        ibc = jnp.clip(ib, lo, hi)
+        return (bt[b, ibc] * K + h // rep, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, H, nb_max),
         in_specs=[
             pl.BlockSpec((1, 1, t, d), lambda b, h, ib, bt, ps: (b, h, 0, 0)),
-            pl.BlockSpec((1, bs, d),
-                         lambda b, h, ib, bt, ps: (bt[b, ib] * K + h // rep, 0, 0)),
-            pl.BlockSpec((1, bs, d),
-                         lambda b, h, ib, bt, ps: (bt[b, ib] * K + h // rep, 0, 0)),
+            pl.BlockSpec((1, bs, d), kv_index),
+            pl.BlockSpec((1, bs, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, t, d), lambda b, h, ib, bt, ps: (b, h, 0, 0)),
         scratch_shapes=[
@@ -163,7 +184,7 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, pos, *, interpret: bool):
     )(block_tables, pos, q, kp, vp)
 
 
-def xla_paged_attention(q, k_pool, v_pool, block_tables, pos):
+def xla_paged_attention(q, k_pool, v_pool, block_tables, pos, window=None):
     """Reference implementation: gather each slot's blocks into a dense cache,
     then masked attention. Used for numeric parity tests and as a fallback."""
     B, t, H, d = q.shape
@@ -179,14 +200,18 @@ def xla_paged_attention(q, k_pool, v_pool, block_tables, pos):
                    preferred_element_type=jnp.float32) / math.sqrt(d)
     row = pos[:, None, None, None] + jnp.arange(t)[None, None, :, None]
     col = jnp.arange(S)[None, None, None, :]
-    s = jnp.where(col <= row, s, NEG_INF)
+    keep = col <= row
+    if window is not None:
+        keep = keep & (col > row - window)
+    s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", p, v_dense)
 
 
 def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                        block_tables: jax.Array, pos: jax.Array,
-                       axis: str = "tp") -> jax.Array:
+                       axis: str = "tp", window: Optional[int] = None
+                       ) -> jax.Array:
     """Tensor-parallel paged attention: heads are embarrassingly parallel, so
     the Pallas kernel runs per-shard under ``shard_map`` with q sharded on H
     and the pools sharded on K (the v2-step TP sharding the reference applies
@@ -197,13 +222,14 @@ def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty or axis not in mesh.axis_names \
             or mesh.shape[axis] <= 1:
-        return paged_attention(q, k_pool, v_pool, block_tables, pos)
+        return paged_attention(q, k_pool, v_pool, block_tables, pos,
+                               window=window)
     tp = mesh.shape[axis]
     H, K = q.shape[2], k_pool.shape[2]
     assert H % tp == 0 and K % tp == 0, (
         f"tp={tp} must divide num_heads={H} and num_kv_heads={K}")
     return jax.shard_map(
-        paged_attention,
+        functools.partial(paged_attention, window=window),
         in_specs=(P(None, None, axis, None), P(None, None, axis, None),
                   P(None, None, axis, None), P(None, None), P(None)),
         out_specs=P(None, None, axis, None),
@@ -214,6 +240,7 @@ def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
 
 def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                     block_tables: jax.Array, pos: jax.Array,
+                    window: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Attention of a dense query tile over each slot's paged KV.
 
@@ -223,10 +250,12 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     already cached per slot BEFORE this tile (the tile's own KV must already be
     appended via :func:`paged_update`). Returns [B, t, H, d].
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = not _on_tpu()
     qt = q.transpose(0, 2, 1, 3)  # [B, H, t, d]
     out = _paged_pallas(qt, k_pool, v_pool,
                         block_tables.astype(jnp.int32), pos.astype(jnp.int32),
-                        interpret=interpret)
+                        window=window, interpret=interpret)
     return out.transpose(0, 2, 1, 3)
