@@ -69,6 +69,11 @@ class TransformerConfig:
     scan_layers: bool = True
     attention_impl: str = "auto"   # auto|xla|flash|ring
     z_loss: float = 0.0
+    # >1: compute the CE loss in T/loss_tiling sequence chunks without ever
+    # materializing the [B, T, V] fp32 logits (ALST TiledFusedLogitsLoss,
+    # ulysses_sp.py:1065) — required for 100k+ contexts where dense logits
+    # alone exceed HBM (128k x 32000 vocab fp32 = 16.8 GB)
+    loss_tiling: int = 0
 
     # MoE (wired by deepspeed_tpu.moe; dense when num_experts <= 1)
     num_experts: int = 1
@@ -522,10 +527,30 @@ class TransformerLM:
         return params
 
     # ---- forward ----------------------------------------------------------
+    def _head(self, params: Params):
+        """[D, V] output projection (tied or separate)."""
+        return (params["embed"]["tokens"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+
+    def _project(self, params: Params, hidden: jax.Array) -> jax.Array:
+        """hidden [B, T, D] → logits [B, T, V] with the canonical sharding."""
+        logits = hidden @ self._head(params).astype(jnp.dtype(self.cfg.dtype))
+        return constrain(logits, P(("dp", "fsdp"), "sp", "tp"))
+
     def logits(self, params: Params, input_ids: jax.Array,
                positions: Optional[jax.Array] = None,
                ltd_seed: Optional[jax.Array] = None,
                pld_theta: Optional[jax.Array] = None) -> jax.Array:
+        return self._project(params, self.hidden_states(
+            params, input_ids, positions=positions, ltd_seed=ltd_seed,
+            pld_theta=pld_theta))
+
+    def hidden_states(self, params: Params, input_ids: jax.Array,
+                      positions: Optional[jax.Array] = None,
+                      ltd_seed: Optional[jax.Array] = None,
+                      pld_theta: Optional[jax.Array] = None) -> jax.Array:
+        """Final-norm hidden states [B, T, D] (everything before the LM
+        head) — the input of the tiled logits loss."""
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         x = params["embed"]["tokens"].astype(dt)[input_ids]
@@ -624,21 +649,48 @@ class TransformerLM:
                 aux_total = aux_total + aux
         x = _norm(x, {k: v for k, v in params["final_norm"].items()}, cfg.norm,
                   cfg.norm_eps)
-        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        logits = x @ head.astype(dt)
         self._last_aux_loss = aux_total
-        return constrain(logits, P(("dp", "fsdp"), "sp", "tp"))
+        return constrain(x, P(("dp", "fsdp"), "sp", None))
+
+    def _tiled_loss(self, params: Params, batch: Dict[str, jax.Array],
+                    hidden: jax.Array) -> jax.Array:
+        """CE over T/loss_tiling chunks — [B, T, V] is never materialized.
+
+        The next-token shift keeps length T by appending one padding label
+        instead of slicing hidden to T-1: T-1 is odd for every even T, which
+        would silently defeat the power-of-two chunking."""
+        from deepspeed_tpu.sequence.tiling import tiled_logits_loss
+
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        if "labels" in batch:
+            labels, h = batch["labels"], hidden
+        else:  # next-token LM loss
+            pad = jnp.full((ids.shape[0], 1), -100, ids.dtype)
+            labels = jnp.concatenate([ids[:, 1:], pad], axis=1)
+            if "attention_mask" in batch:
+                mask = batch["attention_mask"].astype(bool)
+                labels = labels.at[:, :-1].set(
+                    jnp.where(mask[:, 1:], labels[:, :-1], -100))
+            h = hidden
+        head = self._head(params).astype(jnp.dtype(cfg.dtype))
+        return tiled_logits_loss(h, head, labels,
+                                 num_shards=cfg.loss_tiling,
+                                 z_loss=cfg.z_loss)
 
     def loss_fn(self, params: Params, batch: Dict[str, jax.Array],
                 rng: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         seed = batch.get("ltd_seed")
         pld = batch.get("pld_theta")
-        logits = self.logits(params, batch["input_ids"],
-                             ltd_seed=None if seed is None else seed[0],
-                             pld_theta=None if pld is None else pld[0])
-        loss = lm_loss(cfg, logits, batch)
+        hidden = self.hidden_states(
+            params, batch["input_ids"],
+            ltd_seed=None if seed is None else seed[0],
+            pld_theta=None if pld is None else pld[0])
+        if cfg.loss_tiling > 1:
+            loss = self._tiled_loss(params, batch, hidden)
+        else:
+            loss = lm_loss(cfg, self._project(params, hidden), batch)
         aux = getattr(self, "_last_aux_loss", None)
         if aux is not None and cfg.num_experts > 1:
             loss = loss + cfg.moe_aux_loss_coef * aux
@@ -700,9 +752,7 @@ class TransformerLM:
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        logits = x @ head.astype(dt)
+        logits = x @ self._head(params).astype(dt)
         new_cache = {"k": nk, "v": nv, "pos": pos + t}
         return logits, new_cache
 
@@ -764,9 +814,7 @@ class TransformerLM:
         x, (nk, nv) = jax.lax.scan(body, x,
                                    (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        logits = x @ head.astype(dt)
+        logits = x @ self._head(params).astype(dt)
         return logits, {"k": nk, "v": nv}
 
     # ---- sharding ---------------------------------------------------------

@@ -47,9 +47,11 @@ def TiledMLP(mlp_fn: Callable, num_shards: int = 4) -> Callable:
 
 
 def tiled_logits_loss(hidden: jax.Array, head: jax.Array, labels: jax.Array,
-                      num_shards: int = 8, ignore_index: int = -100) -> jax.Array:
+                      num_shards: int = 8, ignore_index: int = -100,
+                      z_loss: float = 0.0) -> jax.Array:
     """Fused tiled logits+CE loss — never materializes [B, T, V]
-    (TiledFusedLogitsLoss ulysses_sp.py:1065)."""
+    (TiledFusedLogitsLoss ulysses_sp.py:1065). ``z_loss`` adds the
+    stabilizing ``z_loss * logsumexp^2`` term per token."""
     B, T, D = hidden.shape
     while T % num_shards != 0:
         num_shards -= 1
@@ -60,10 +62,15 @@ def tiled_logits_loss(hidden: jax.Array, head: jax.Array, labels: jax.Array,
         h, l = args
         logits = (h @ head).astype(jnp.float32)
         logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        mask = l != ignore_index
+        # ALL negative labels are padding (dense lm_loss masks labels < 0;
+        # -100 is just the HF spelling of it)
+        mask = (l >= 0) & (l != ignore_index)
         safe = jnp.maximum(l, 0)
         gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-        nll = jnp.where(mask, logz - gold, 0.0)
+        nll = logz - gold
+        if z_loss > 0.0:
+            nll = nll + z_loss * jnp.square(logz)
+        nll = jnp.where(mask, nll, 0.0)
         return nll.sum(), mask.sum()
 
     body = jax.checkpoint(chunk_loss)
