@@ -33,6 +33,44 @@ PRESETS = {
                                       num_heads=32, num_kv_heads=8, intermediate_size=14336,
                                       max_seq_len=8192, arch="llama", num_experts=8,
                                       top_k=2),
+    # family presets matching the reference's v2 model_implementations set
+    # Mistral-7B-v0.1 (theta 10000 + 4k sliding window; v0.3 is theta 1e6
+    # with no window — use overrides for that variant)
+    "mistral-7b": TransformerConfig(vocab_size=32000, hidden_size=4096,
+                                    num_layers=32, num_heads=32, num_kv_heads=8,
+                                    intermediate_size=14336, max_seq_len=32768,
+                                    arch="llama", tie_embeddings=False,
+                                    sliding_window=4096),
+    "qwen2-7b": TransformerConfig(vocab_size=152064, hidden_size=3584,
+                                  num_layers=28, num_heads=28, num_kv_heads=4,
+                                  intermediate_size=18944, max_seq_len=32768,
+                                  arch="llama", rope_theta=1000000.0,
+                                  tie_embeddings=False, norm_eps=1e-6,
+                                  qkv_bias=True),
+    "phi3-mini": TransformerConfig(vocab_size=32064, hidden_size=3072,
+                                   num_layers=32, num_heads=32, num_kv_heads=32,
+                                   intermediate_size=8192, max_seq_len=4096,
+                                   arch="llama", tie_embeddings=False),
+    "falcon-7b": TransformerConfig(vocab_size=65024, hidden_size=4544,
+                                   num_layers=32, num_heads=71, num_kv_heads=1,
+                                   intermediate_size=18176, max_seq_len=2048,
+                                   arch="gpt2", norm="layernorm",
+                                   activation="gelu_exact", use_rope=True,
+                                   learned_pos=False, parallel_block=True,
+                                   parallel_shared_norm=True),
+    "pythia-1b": TransformerConfig(vocab_size=50304, hidden_size=2048,
+                                   num_layers=16, num_heads=8,
+                                   intermediate_size=8192, max_seq_len=2048,
+                                   arch="gpt2", use_rope=True, learned_pos=False,
+                                   rope_pct=0.25, parallel_block=True,
+                                   qkv_bias=True, proj_bias=True,
+                                   activation="gelu_exact",
+                                   tie_embeddings=False),
+    "opt-1.3b": TransformerConfig(vocab_size=50272, hidden_size=2048,
+                                  num_layers=24, num_heads=32,
+                                  intermediate_size=8192, max_seq_len=2048,
+                                  arch="gpt2", activation="relu",
+                                  qkv_bias=True, proj_bias=True),
 }
 
 
