@@ -83,3 +83,32 @@ def test_num_params_estimate_close():
     actual = num_params(model.init(jax.random.key(0)))
     est = cfg.num_params_estimate()
     assert abs(est - actual) / actual < 0.05
+
+
+def test_new_family_knobs_train_under_engine(eight_devices):
+    """parallel_block + shared norm + qkv/proj biases + partial rotary must
+    train under the engine (zero-3 + tp shards the bias params too)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=64, arch="gpt2",
+                            use_rope=True, learned_pos=False, rope_pct=0.5,
+                            parallel_block=True, parallel_shared_norm=True,
+                            qkv_bias=True, proj_bias=True,
+                            activation="gelu_exact")
+    eng, *_ = ds.initialize(model=TransformerLM(cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+        "mesh": {"fsdp": 4, "tp": 2}, "steps_per_print": 100})
+    assert "ln2" not in eng.params["layers"]          # shared norm
+    assert "bq" in eng.params["layers"]["attn"]       # biases exist
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (8, 32))}
+    losses = []
+    for _ in range(4):
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
