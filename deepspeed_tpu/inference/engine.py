@@ -24,11 +24,11 @@ from deepspeed_tpu.utils.logging import log_dist
 
 
 def sample_token(logits, temperature: float, top_k: int, rng,
-                 with_logprob: bool = False):
-    """Greedy / temperature / top-k sampling of the next token; optionally
-    also the token's logprob under the SAMPLING distribution (the behavior
-    policy — collected here because re-scoring a top-k-filtered distribution
-    later is numerically fragile at the k-th boundary)."""
+                 with_logprob: bool = False, top_p: float = 1.0):
+    """Greedy / temperature / top-k / nucleus (top-p) sampling of the next
+    token; optionally also the token's logprob under the SAMPLING
+    distribution (the behavior policy — collected here because re-scoring a
+    filtered distribution later is numerically fragile at the boundary)."""
     if temperature <= 0.0:
         tok = jnp.argmax(logits, axis=-1)
         lp = logits.astype(jnp.float32)
@@ -37,6 +37,15 @@ def sample_token(logits, temperature: float, top_k: int, rng,
         if top_k > 0:
             vals, _ = jax.lax.top_k(lp, top_k)
             lp = jnp.where(lp < vals[:, -1:], -jnp.inf, lp)
+        if top_p < 1.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # whose mass reaches top_p (the cutoff token inclusive)
+            probs = jax.nn.softmax(lp, axis=-1)
+            sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+            cum = jnp.cumsum(sorted_p, axis=-1)
+            k_idx = jnp.argmax(cum >= top_p, axis=-1)
+            cutoff = jnp.take_along_axis(sorted_p, k_idx[:, None], axis=-1)
+            lp = jnp.where(probs < cutoff, -jnp.inf, lp)
         tok = jax.random.categorical(rng, lp, axis=-1)
     if not with_logprob:
         return tok
@@ -47,7 +56,7 @@ def sample_token(logits, temperature: float, top_k: int, rng,
 def generate_loop(step_fn, params, mesh, init_cache_fn, ids: np.ndarray,
                   total: int, temperature: float, top_k: int, seed: int,
                   eos_token_id: Optional[int],
-                  return_logprobs: bool = False):
+                  return_logprobs: bool = False, top_p: float = 1.0):
     """The autoregressive prefill+decode loop shared by the inference and
     hybrid engines: jitted prefill, per-token sample, pad-with-EOS after a
     sequence finishes, early exit when all are done. With
@@ -65,7 +74,7 @@ def generate_loop(step_fn, params, mesh, init_cache_fn, ids: np.ndarray,
         for _ in range(total - T):
             rng, sub = jax.random.split(rng)
             nxt, lp = sample_token(next_logits, temperature, top_k, sub,
-                                   with_logprob=True)
+                                   with_logprob=True, top_p=top_p)
             nxt_np = np.asarray(nxt)
             lp_np = np.asarray(lp)
             if eos_token_id is not None:

@@ -59,7 +59,7 @@ class DeepSpeedTpuHybridEngine(DeepSpeedTpuEngine):
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  eos_token_id: Optional[int] = None,
-                 return_logprobs: bool = False):
+                 return_logprobs: bool = False, top_p: float = 1.0):
         """Autoregressive generation with the LIVE training params
         (hybrid_engine.py:238 ``generate``). ``max_new_tokens`` defaults to
         the config's ``hybrid_engine.max_out_tokens``; ``return_logprobs``
@@ -74,15 +74,16 @@ class DeepSpeedTpuHybridEngine(DeepSpeedTpuEngine):
         return generate_loop(self._gen_step, self.params, self.mesh,
                              self.module.init_kv_cache, ids, total,
                              temperature, top_k, seed, eos_token_id,
-                             return_logprobs=return_logprobs)
+                             return_logprobs=return_logprobs, top_p=top_p)
 
     def score_logprobs(self, sequences, prompt_len: int,
-                       temperature: float = 1.0, top_k: int = 0) -> np.ndarray:
+                       temperature: float = 1.0, top_k: int = 0,
+                       top_p: float = 1.0) -> np.ndarray:
         """Per-token logprobs of each sequence's response tokens under the
         CURRENT params and the GIVEN sampling transform — pass the rollout's
-        temperature/top_k so these are true behavior-policy logprobs (PPO
-        importance ratios are biased otherwise). ``temperature <= 0`` (greedy
-        rollouts) scores the raw distribution."""
+        temperature/top_k/top_p so these are true behavior-policy logprobs
+        (PPO importance ratios are biased otherwise). ``temperature <= 0``
+        (greedy rollouts) scores the raw distribution."""
         self._ensure_gen_fns()
         seq = jnp.asarray(np.asarray(sequences))
         with jax.sharding.set_mesh(self.mesh):
@@ -92,10 +93,28 @@ class DeepSpeedTpuHybridEngine(DeepSpeedTpuEngine):
             if top_k > 0:
                 vals = jax.lax.top_k(logits, top_k)[0]
                 logits = jnp.where(logits < vals[..., -1:], -jnp.inf, logits)
+            if temperature > 0.0 and top_p < 1.0:
+                probs = jax.nn.softmax(logits, axis=-1)
+                sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+                cum = jnp.cumsum(sorted_p, axis=-1)
+                k_idx = jnp.argmax(cum >= top_p, axis=-1)
+                cutoff = jnp.take_along_axis(sorted_p, k_idx[..., None],
+                                             axis=-1)
+                logits = jnp.where(probs < cutoff, -jnp.inf, logits)
             logp = jax.nn.log_softmax(logits, axis=-1)
             tok_lp = jnp.take_along_axis(logp[:, :-1], seq[:, 1:, None],
                                          axis=-1)[..., 0]
         return np.asarray(tok_lp[:, prompt_len - 1:])
+
+
+def response_mask(resp: np.ndarray, eos_token_id: Optional[int]) -> np.ndarray:
+    """Real-token mask for a response region: tokens up to and INCLUDING the
+    first EOS are real, everything after is forced padding. The single source
+    of the EOS-masking convention for every rollout surface."""
+    if eos_token_id is None:
+        return np.ones_like(resp, bool)
+    ended = np.cumsum(resp == eos_token_id, axis=-1)
+    return (ended == 0) | ((resp == eos_token_id) & (ended == 1))
 
 
 class RolloutCollector:
@@ -123,11 +142,6 @@ class RolloutCollector:
             top_k=top_k, seed=seed, eos_token_id=eos_token_id,
             return_logprobs=True)
         resp = seqs[:, T:]
-        if eos_token_id is not None:
-            ended = np.cumsum(resp == eos_token_id, axis=1)
-            # tokens up to and including the first EOS are real
-            mask = (ended == 0) | ((resp == eos_token_id) & (ended == 1))
-        else:
-            mask = np.ones_like(resp, bool)
+        mask = response_mask(resp, eos_token_id)
         return {"sequences": seqs, "response_mask": mask,
                 "logprobs": logprobs, "prompt_len": T}

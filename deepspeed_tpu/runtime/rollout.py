@@ -1,0 +1,208 @@
+"""Rollout engine interface + hybrid-engine implementation.
+
+Parity target: ``deepspeed/runtime/rollout/base.py`` (``RolloutConfig`` /
+``SamplingConfig`` / ``RolloutRequest`` / ``RolloutBatch`` / ``RolloutEngine``
+ABC) and ``hybrid_engine_rollout.py:29`` (``HybridEngineRollout``). The
+trainer loop talks to generation through these three small dataclasses and
+one ABC, keeping backend specifics (hybrid engine vs remote servers) out of
+the PPO loop.
+
+TPU adaptation: prompts arrive LEFT-padded (reference convention — real
+tokens at the right edge). Our KV-cache prefill is dense, so pad tokens must
+not enter attention; rows are therefore grouped by real prompt length,
+generated per group (group row-counts pad up to powers of two so a small set
+of compiled shapes covers shifting PPO length histograms), and re-assembled
+right-padded. Weight sync is a no-op: the hybrid engine
+generates with the live training param tree (``sync_weights`` has nothing to
+push).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["RolloutConfig", "SamplingConfig", "RolloutRequest",
+           "RolloutBatch", "RolloutEngine", "HybridEngineRollout"]
+
+
+@dataclasses.dataclass
+class RolloutConfig:
+    """reference base.py ``RolloutConfig``. ``use_graph_capture`` has no TPU
+    switch — jit IS graph capture, always on."""
+
+    engine: str = "hybrid_engine"
+    use_graph_capture: bool = True
+
+
+@dataclasses.dataclass
+class SamplingConfig:
+    """Sampling knobs the trainer passes to ``generate`` each step.
+
+    ``seed`` varies the RNG between calls — reuse the same seed only when
+    byte-identical rollouts are wanted."""
+
+    max_new_tokens: int
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1
+    n_samples_per_prompt: int = 1
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class RolloutRequest:
+    """Input to ``RolloutEngine.generate`` — left-padded prompts."""
+
+    prompt_ids: np.ndarray            # [B, T_p], left-padded
+    prompt_attention_mask: np.ndarray  # [B, T_p], 1 on real prompt tokens
+
+    def __post_init__(self) -> None:
+        self.prompt_ids = np.asarray(self.prompt_ids)
+        self.prompt_attention_mask = np.asarray(self.prompt_attention_mask)
+        if self.prompt_ids.ndim != 2:
+            raise ValueError("prompt_ids must be 2-D [B, T_p]; got "
+                             f"{self.prompt_ids.shape}")
+        if self.prompt_attention_mask.shape != self.prompt_ids.shape:
+            raise ValueError(
+                f"prompt_attention_mask shape "
+                f"{self.prompt_attention_mask.shape} does not match "
+                f"prompt_ids {self.prompt_ids.shape}")
+        m = self.prompt_attention_mask.astype(bool)
+        # left-padded = the mask is exactly a suffix of ones per row
+        lens = m.sum(axis=1)
+        T = m.shape[1]
+        expect = np.arange(T)[None, :] >= (T - lens[:, None])
+        if np.any(lens == 0) or not np.array_equal(m, expect):
+            raise ValueError("prompts must be LEFT-padded (mask a contiguous "
+                             "run of ones at the right edge, >= 1 real token)")
+
+
+@dataclasses.dataclass
+class RolloutBatch:
+    """Output of ``RolloutEngine.generate``: prompt+response concatenated,
+    right-padded to the longest sequence. ``logprobs`` (TPU extra) carries
+    the behavior-policy logprob of every response token (0 on padding)."""
+
+    input_ids: np.ndarray          # [B', T]; B' = B * n_samples_per_prompt
+    attention_mask: np.ndarray     # [B', T]
+    response_start_idx: np.ndarray  # [B'] int
+    logprobs: Optional[np.ndarray] = None  # [B', T_resp_max]
+
+    def __post_init__(self) -> None:
+        if self.input_ids.ndim != 2:
+            raise ValueError(f"input_ids must be 2-D; got {self.input_ids.shape}")
+        if self.attention_mask.shape != self.input_ids.shape:
+            raise ValueError("attention_mask shape mismatch")
+        if self.response_start_idx.shape != (self.input_ids.shape[0],):
+            raise ValueError("response_start_idx must be 1-D of length B")
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.input_ids.shape[0])
+
+    @property
+    def seq_len(self) -> int:
+        return int(self.input_ids.shape[1])
+
+
+class RolloutEngine(abc.ABC):
+    """Abstract base for rollout engines (base.py:88)."""
+
+    name: str = "base"
+
+    @abc.abstractmethod
+    def generate(self, request: RolloutRequest,
+                 sampling: SamplingConfig) -> RolloutBatch:
+        """Run generation, return prompt+response in one array."""
+
+    @abc.abstractmethod
+    def sync_weights(self, step: int) -> None:
+        """Push updated weights into the rollout backend (no-op when
+        co-located with the trainer)."""
+
+    def shutdown(self) -> None:
+        """Release backend resources. Default no-op."""
+
+
+class HybridEngineRollout(RolloutEngine):
+    """Rollout over the hybrid engine's live training params
+    (hybrid_engine_rollout.py:29). Generation runs in the same process on
+    the same mesh; sync_weights is free by construction."""
+
+    name = "hybrid_engine"
+
+    def __init__(self, engine, eos_token_id: Optional[int] = None,
+                 config: Optional[RolloutConfig] = None):
+        self.engine = engine
+        self.eos_token_id = eos_token_id
+        self.config = config or RolloutConfig()
+
+    def generate(self, request: RolloutRequest,
+                 sampling: SamplingConfig) -> RolloutBatch:
+        mask = request.prompt_attention_mask.astype(bool)
+        lens = mask.sum(axis=1)
+        n = max(1, int(sampling.n_samples_per_prompt))
+        B = request.prompt_ids.shape[0]
+        top_k = max(0, int(sampling.top_k))  # reference uses -1 = off
+        rows: Dict[int, Any] = {}
+        # group rows by real length: dense prefill must not see pad tokens.
+        # Row counts pad up to the next power of two (repeating row 0) so
+        # recurring PPO steps with shifting length histograms reuse a small
+        # set of compiled shapes instead of recompiling per group size.
+        for length in np.unique(lens):
+            idx = np.nonzero(lens == length)[0]
+            prompts = np.stack([request.prompt_ids[i, -length:] for i in idx])
+            if n > 1:
+                prompts = np.repeat(prompts, n, axis=0)
+            real = prompts.shape[0]
+            padded = 1 << (real - 1).bit_length()
+            if padded > real:
+                prompts = np.concatenate(
+                    [prompts, np.repeat(prompts[:1], padded - real, axis=0)])
+            seqs, lps = self.engine.generate(
+                prompts, max_new_tokens=sampling.max_new_tokens,
+                temperature=sampling.temperature, top_k=top_k,
+                top_p=sampling.top_p, eos_token_id=self.eos_token_id,
+                seed=sampling.seed + int(length),  # decorrelate groups
+                return_logprobs=True)
+            for j, i in enumerate(np.repeat(idx, n)):
+                s = np.asarray(seqs[j])
+                rows.setdefault(int(i), []).append(
+                    (s, int(length), np.asarray(lps[j])))
+        total = max(s.shape[0] for rs in rows.values() for s, _, _ in rs)
+        resp_max = max(s.shape[0] - L for rs in rows.values()
+                       for s, L, _ in rs)
+        pad_id = (self.eos_token_id if self.eos_token_id is not None else 0)
+        out_ids, out_mask, out_start, out_lp = [], [], [], []
+        for i in range(B):
+            for s, L, lp in rows[i]:
+                T = s.shape[0]
+                ids = np.full((total,), pad_id, s.dtype)
+                ids[:T] = s
+                am = np.zeros((total,), np.int32)
+                am[:T] = 1
+                if self.eos_token_id is not None:
+                    # post-EOS forced pads are not real tokens
+                    from deepspeed_tpu.runtime.hybrid_engine import \
+                        response_mask
+                    am[L:T] = response_mask(s[L:],
+                                            self.eos_token_id).astype(np.int32)
+                lpp = np.zeros((resp_max,), np.float32)
+                lpp[:lp.shape[0]] = lp
+                out_ids.append(ids)
+                out_mask.append(am)
+                out_start.append(L)
+                out_lp.append(lpp)
+        return RolloutBatch(input_ids=np.stack(out_ids),
+                            attention_mask=np.stack(out_mask),
+                            response_start_idx=np.asarray(out_start),
+                            logprobs=np.stack(out_lp))
+
+    def sync_weights(self, step: int) -> None:
+        """The hybrid engine samples from the live training tree — nothing
+        to push (the reference's container gather/release collapses into XLA
+        per-use gathers)."""

@@ -110,3 +110,65 @@ def test_hybrid_with_pipeline_raises(eight_devices):
         "steps_per_print": 100})
     with pytest.raises(ValueError, match="forward_with_cache"):
         eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=2)
+
+
+class TestRolloutEngineAPI:
+    """reference runtime/rollout/base.py parity: the dataclass + ABC surface
+    and the hybrid-engine implementation over left-padded ragged prompts."""
+
+    def test_left_padded_ragged_generate(self, eight_devices):
+        from deepspeed_tpu.runtime.rollout import (HybridEngineRollout,
+                                                   RolloutRequest,
+                                                   SamplingConfig)
+
+        eng = make_engine(stage=0, mesh={"dp": 8})
+        rng = np.random.default_rng(7)
+        # two real lengths (4 and 6), left-padded to 6 with token 0
+        p0 = rng.integers(1, 256, 4)
+        p1 = rng.integers(1, 256, 6)
+        ids = np.zeros((2, 6), np.int64)
+        ids[0, 2:] = p0
+        ids[1] = p1
+        mask = np.zeros((2, 6), np.int64)
+        mask[0, 2:] = 1
+        mask[1] = 1
+        roll = HybridEngineRollout(eng)
+        batch = roll.generate(RolloutRequest(ids, mask),
+                              SamplingConfig(max_new_tokens=5,
+                                             temperature=0.0))
+        assert batch.batch_size == 2
+        assert list(batch.response_start_idx) == [4, 6]
+        # prompts preserved verbatim at the FRONT (pads stripped)
+        np.testing.assert_array_equal(batch.input_ids[0, :4], p0)
+        np.testing.assert_array_equal(batch.input_ids[1, :6], p1)
+        # row 0 must equal generating its unpadded prompt directly — pads
+        # never entered attention
+        direct = eng.generate(p0[None], max_new_tokens=5, temperature=0.0)
+        np.testing.assert_array_equal(batch.input_ids[0, :direct.shape[1]],
+                                      direct[0])
+        assert batch.attention_mask[0, :9].all()
+        assert batch.logprobs is not None
+        roll.sync_weights(0)  # no-op, must not raise
+        roll.shutdown()
+
+    def test_n_samples_and_validation(self, eight_devices):
+        import pytest as _pytest
+
+        from deepspeed_tpu.runtime.rollout import (HybridEngineRollout,
+                                                   RolloutRequest,
+                                                   SamplingConfig)
+
+        eng = make_engine(stage=0, mesh={"dp": 8})
+        rng = np.random.default_rng(8)
+        ids = rng.integers(1, 256, (2, 5))
+        mask = np.ones((2, 5), np.int64)
+        batch = HybridEngineRollout(eng).generate(
+            RolloutRequest(ids, mask),
+            SamplingConfig(max_new_tokens=3, temperature=0.8, top_p=0.9,
+                           n_samples_per_prompt=2, top_k=-1))
+        assert batch.batch_size == 4  # B * n_samples
+        # right-padded prompts are rejected (reference contract: left-padded)
+        bad_mask = np.ones((2, 5), np.int64)
+        bad_mask[0, 3:] = 0  # zeros at the RIGHT edge
+        with _pytest.raises(ValueError, match="LEFT-padded"):
+            RolloutRequest(ids, bad_mask)
