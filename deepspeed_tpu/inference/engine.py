@@ -131,13 +131,15 @@ class InferenceEngine:
     __call__ = forward
 
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None):
-        """Greedy / top-k sampled generation with a static KV cache."""
+                 top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None,
+                 top_p: float = 1.0):
+        """Greedy / top-k / nucleus sampled generation with a static KV cache."""
         ids = np.asarray(input_ids)
         total = min(self.max_seq_len, ids.shape[1] + max_new_tokens)
         return generate_loop(self._step, self.params, self.mesh,
                              self.module.init_kv_cache, ids, total,
-                             temperature, top_k, seed, eos_token_id)
+                             temperature, top_k, seed, eos_token_id,
+                             top_p=top_p)
 
     # back-compat alias (hybrid engine + older call sites)
     _sample = staticmethod(sample_token)
