@@ -81,8 +81,13 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     # "capacity" (GShard einsum, the EP form) | "grouped" (dropless
-    # ragged_dot grouped GEMM — single expert shard)
+    # ragged_dot grouped GEMM; under ep>1 routes through a padded a2a over
+    # the ep axis to per-shard grouped GEMMs)
     moe_dispatch: str = "capacity"
+    # a2a capacity for grouped-under-ep: 0 → worst-case dropless
+    # (cap = S_local*top_k); f>0 → cap ≈ S_local*top_k*f/ep (may drop
+    # overflow pairs under extreme router imbalance)
+    moe_ep_capacity_factor: float = 0.0
 
     def __post_init__(self):
         is_llama = self.arch == "llama"
@@ -340,10 +345,16 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _decode_block(h: jax.Array, wc: Params, cfg: TransformerConfig,
                   freqs: Optional[jax.Array], positions: jax.Array,
-                  attn_cache_fn: Callable) -> jax.Array:
+                  attn_cache_fn: Callable,
+                  moe_fn: Optional[Callable] = None) -> jax.Array:
     """One decoder block on the decode path. ``attn_cache_fn(q, k, v)`` owns
     the cache append + attention and returns [B, t, H, hd]. Mirrors
-    :func:`transformer_block` (parallel residual, shared norm, biases)."""
+    :func:`transformer_block` (parallel residual, shared norm, biases, MoE)."""
+    def _mlp(hn):
+        if moe_fn is not None:
+            return moe_fn(hn, wc["mlp"], cfg)[0]  # aux loss unused at decode
+        return mlp_block(hn, wc["mlp"], cfg)
+
     hn1 = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
     q, k, v = qkv_proj(hn1, wc["attn"], cfg)
     if cfg.use_rope:
@@ -353,10 +364,10 @@ def _decode_block(h: jax.Array, wc: Params, cfg: TransformerConfig,
     if cfg.parallel_block:
         hn2 = (hn1 if cfg.parallel_shared_norm
                else _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps))
-        return h + attn_out + mlp_block(hn2, wc["mlp"], cfg)
+        return h + attn_out + _mlp(hn2)
     h = h + attn_out
     hn2 = _norm(h, wc["ln2"], cfg.norm, cfg.norm_eps)
-    return h + mlp_block(hn2, wc["mlp"], cfg)
+    return h + _mlp(hn2)
 
 
 def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
@@ -747,7 +758,8 @@ class TransformerLM:
                                      - cfg.sliding_window)
                 return _cached_attention(q, nk, nv, valid)
 
-            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn)
+            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
+                              self.moe_fn)
             return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
@@ -808,7 +820,8 @@ class TransformerLM:
                 return paged_attention_tp(q, nk, nv, block_tables, pos,
                                           window=cfg.sliding_window)
 
-            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn)
+            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
+                              self.moe_fn)
             return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x,

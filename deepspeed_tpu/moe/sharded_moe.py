@@ -118,6 +118,20 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
     return y.reshape(B, T, D), aux
 
 
+def _grouped_ffn(xs: jax.Array, group_sizes: jax.Array, w: Dict[str, jax.Array],
+                 dt) -> jax.Array:
+    """Expert-grouped FFN over tokens sorted by expert: the
+    ``lax.ragged_dot`` chain XLA lowers to a grouped (MegaBlocks-style) GEMM."""
+    if "w_gate" in w:
+        act = jax.nn.silu(jax.lax.ragged_dot(xs, w["w_gate"].astype(dt),
+                                             group_sizes))
+        act = act * jax.lax.ragged_dot(xs, w["w_up"].astype(dt), group_sizes)
+    else:
+        act = jax.nn.gelu(jax.lax.ragged_dot(xs, w["w_up"].astype(dt),
+                                             group_sizes), approximate=True)
+    return jax.lax.ragged_dot(act, w["w_down"].astype(dt), group_sizes)
+
+
 def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
                           ) -> Tuple[jax.Array, jax.Array]:
     """Dropless sort-based dispatch over grouped GEMMs — the
@@ -127,15 +141,15 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
     Unlike the capacity path, every (token, expert) pair is computed — no
     ``capacity_factor`` padding waste and no dropped tokens — at the price of
     data-dependent group sizes (static TOTAL shape ``S*k``, so it still jits).
-    Single-shard experts only: under ``ep > 1`` the grouped contraction cannot
-    be partitioned over the expert axis — the capacity einsum path is the EP
-    form (use ``moe_dispatch="capacity"``).
+    Under ``ep > 1`` dispatch routes through ``_grouped_moe_ep`` — an explicit
+    padded all-to-all over the ``ep`` axis feeding per-shard grouped GEMMs (the
+    ``_AllToAll`` of reference ``moe/sharded_moe.py:97``, made dropless).
     """
     mesh = jax.sharding.get_abstract_mesh()
     if (mesh is not None and not mesh.empty and "ep" in mesh.axis_names
-            and mesh.shape["ep"] > 1):
-        raise ValueError("grouped MoE dispatch does not partition over ep>1; "
-                         "use moe_dispatch='capacity' for expert parallelism")
+            and mesh.shape["ep"] > 1
+            and "ep" not in set(getattr(mesh, "manual_axes", ()) or ())):
+        return _grouped_moe_ep(h, w, cfg, mesh)
     B, T, D = h.shape
     E = w["router"].shape[-1]
     k = cfg.top_k
@@ -151,17 +165,129 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
 
     dt = h.dtype
     xs = x[tok].astype(dt)                                    # [S*k, D]
-    if "w_gate" in w:
-        act = jax.nn.silu(jax.lax.ragged_dot(xs, w["w_gate"].astype(dt),
-                                             group_sizes))
-        act = act * jax.lax.ragged_dot(xs, w["w_up"].astype(dt), group_sizes)
-    else:
-        act = jax.nn.gelu(jax.lax.ragged_dot(xs, w["w_up"].astype(dt),
-                                             group_sizes), approximate=True)
-    ys = jax.lax.ragged_dot(act, w["w_down"].astype(dt), group_sizes)  # [S*k, D]
+    ys = _grouped_ffn(xs, group_sizes, w, dt)                 # [S*k, D]
     weights = topk_vals.reshape(-1)[order].astype(dt)
     out = jnp.zeros((S, D), dt).at[tok].add(ys * weights[:, None])
     return out.reshape(B, T, D), aux_loss
+
+
+def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
+                    mesh) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel dropless dispatch: tokens resharded over ``ep``, routed
+    through a capacity-padded ``all_to_all`` to the shard owning each expert,
+    run through the local grouped GEMM, and returned by the mirror a2a.
+
+    This is the explicit-collective form of reference
+    ``moe/sharded_moe.py:97`` (``_AllToAll`` over the EP process group) with
+    MegaBlocks-style grouped compute instead of the capacity einsum — every
+    routed (token, expert) pair is computed exactly, so an imported Mixtral
+    keeps its released routing function under ``ep > 1``.
+
+    Shapes are static: the a2a payload is ``[ep, cap, D+2]`` per shard (the
+    two extra lanes carry the routed expert id, so the id exchange rides the
+    same collective), with ``cap = S_local * top_k`` by default (worst-case
+    dropless — total payload equals the single-shard dispatch size).
+    ``cfg.moe_ep_capacity_factor > 0`` shrinks ``cap`` toward the
+    balanced-load size ``S_local*k/ep`` at the cost of dropping overflow
+    pairs under extreme imbalance (documented trade, like the reference's
+    ``capacity_factor``). Token count is padded up to a multiple of ``ep``
+    (pad rows route with zero combine weight and are masked out of the aux
+    stats), so B=1 single-request decode works on any ep mesh.
+    """
+    B, T, D = h.shape
+    E = w["router"].shape[-1]
+    ep = mesh.shape["ep"]
+    k = cfg.top_k
+    if E % ep:
+        raise ValueError(f"num_experts ({E}) must divide by ep ({ep})")
+    if E > 127 * 128 - 1:
+        raise ValueError(f"num_experts ({E}) exceeds the id-lane encoding")
+    e_local = E // ep
+    S = B * T
+    s_local = -(-S // ep)          # ceil: pad rows are masked below
+    s_pad = s_local * ep
+    factor = float(getattr(cfg, "moe_ep_capacity_factor", 0.0) or 0.0)
+    if factor > 0.0:
+        cap = min(s_local * k, int(math.ceil(s_local * k / ep * factor)))
+    else:
+        cap = s_local * k
+    dt = h.dtype
+
+    def shard(x, router, wl):
+        my = jax.lax.axis_index("ep")
+        # pad-row mask: rows at global index >= S are padding
+        real = (my * s_local + jnp.arange(s_local)) < S        # [S_l]
+        logits = x.astype(jnp.float32) @ router
+        gates = jax.nn.softmax(logits, axis=-1)                # [S_l, E]
+        mask1 = jax.nn.one_hot(jnp.argmax(gates, -1), E, dtype=jnp.float32)
+        rf = real[:, None].astype(jnp.float32)
+        # global-batch aux loss: psum-of-sums == the ep=1 _route() means
+        g_mean = jax.lax.psum((gates * rf).sum(0), "ep") / S
+        m_mean = jax.lax.psum((mask1 * rf).sum(0), "ep") / S
+        aux = jnp.sum(g_mean * m_mean) * E
+        topk_vals, topk_idx = jax.lax.top_k(gates, k)          # [S_l, k]
+        topk_vals = topk_vals / jnp.maximum(
+            topk_vals.sum(-1, keepdims=True), 1e-9)
+
+        n = s_local * k
+        flat_e = topk_idx.reshape(-1)                          # [n]
+        dest = flat_e // e_local                               # owning ep shard
+        oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32)
+        slot = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=1)  # per-dest pos
+        tok = jnp.arange(n) // k
+        # expert id rides the activation payload as two bf16-exact lanes
+        # (hi/lo base-128 digits of flat_e+1; 0 = empty slot) — one a2a, not two
+        eid = flat_e + 1
+        id_hi = (eid // 128).astype(dt)
+        id_lo = (eid % 128).astype(dt)
+        payload = jnp.concatenate(
+            [x[tok].astype(dt), id_hi[:, None], id_lo[:, None]], axis=1)
+        send = jnp.zeros((ep, cap, D + 2), dt).at[dest, slot].set(
+            payload, mode="drop")
+        recv = jax.lax.all_to_all(send, "ep", 0, 0, tiled=True)
+
+        flat = recv.reshape(ep * cap, D + 2)
+        re = (flat[:, D].astype(jnp.int32) * 128
+              + flat[:, D + 1].astype(jnp.int32)) - 1
+        valid = re >= 0
+        local_e = jnp.where(valid, re - my * e_local, 0)
+        rx = jnp.where(valid[:, None], flat[:, :D], 0)  # pad rows → zero io
+        order = jnp.argsort(local_e)
+        xs = rx[order]
+        group_sizes = jnp.bincount(local_e, length=e_local).astype(jnp.int32)
+        ys = _grouped_ffn(xs, group_sizes, wl, dt)             # [ep*cap, D]
+        y_back = jax.lax.all_to_all(
+            jnp.zeros_like(ys).at[order].set(ys).reshape(ep, cap, D),
+            "ep", 0, 0, tiled=True)
+
+        keep = (slot < cap).astype(dt)                         # 1 unless factor drops
+        wgt = topk_vals.reshape(-1).astype(dt) * keep \
+            * jnp.repeat(real, k).astype(dt)
+        y_pair = y_back[dest, jnp.minimum(slot, cap - 1)]      # [n, D]
+        out = jnp.zeros((s_local, D), dt).at[tok].add(y_pair * wgt[:, None])
+        return out, aux
+
+    ew = P("ep", None, None)
+    experts = {n: v for n, v in w.items() if n != "router"}
+    x2 = h.reshape(S, D)
+    if s_pad != S:
+        x2 = jnp.concatenate([x2, jnp.zeros((s_pad - S, D), x2.dtype)], axis=0)
+    # router enters replicated-over-ep in fp32: its cotangent is a psum over
+    # ep, and a *bf16* replicated-in grad trips an XLA:CPU check failure in
+    # AllReducePromotion (all-reduce with copy reduction); fp32 sidesteps it
+    # and is what _route computes in anyway.
+    out2, aux = jax.shard_map(
+        shard, mesh=mesh,
+        in_specs=(P("ep", None), P(None, None), {n: ew for n in experts}),
+        out_specs=(P("ep", None), P()), axis_names={"ep"},
+        check_vma=False)(x2, w["router"].astype(jnp.float32), experts)
+    if s_pad != S:
+        # the sliced-off-pad result has no expressible ep sharding — pin it
+        # replicated (pad only occurs at decode-sized S, where this is cheap)
+        out2 = constrain(out2[:S], P(None, None))
+    else:
+        out2 = out2[:S]
+    return out2.reshape(B, T, D), aux
 
 
 def moe_block_for(cfg: Any):

@@ -157,7 +157,118 @@ class TestGroupedDispatch:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
-    def test_grouped_rejects_ep(self, eight_devices):
+class TestGroupedEP:
+    """Expert-parallel dropless dispatch (reference ``_AllToAll``
+    moe/sharded_moe.py:97 + cutlass moe_gemm, as a padded a2a over ``ep``)."""
+
+    @staticmethod
+    def _weights(key, D=16, F=32, E=8):
+        rng = jax.random.split(key, 5)
+        w = {"router": jax.random.normal(rng[0], (D, E)) * 0.1,
+             "w_gate": jax.random.normal(rng[1], (E, D, F)) / 4,
+             "w_up": jax.random.normal(rng[2], (E, D, F)) / 4,
+             "w_down": jax.random.normal(rng[3], (E, F, D)) / 6}
+        return w, rng[4]
+
+    @staticmethod
+    def _ep_mesh(devices, ep=4, dp=2):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(devices[:ep * dp]).reshape(ep, dp), ("ep", "dp"))
+
+    def test_ep_matches_single_shard(self, eight_devices):
+        from deepspeed_tpu.moe import grouped_moe_mlp_block
+
+        class Cfg:
+            top_k = 2
+            moe_ep_capacity_factor = 0.0
+
+        w, hk = self._weights(jax.random.key(0))
+        h = jax.random.normal(hk, (4, 16, 16))
+        y1, aux1 = grouped_moe_mlp_block(h, w, Cfg())
+        with jax.sharding.set_mesh(self._ep_mesh(eight_devices)):
+            y2, aux2 = jax.jit(grouped_moe_mlp_block, static_argnums=2)(
+                h, w, Cfg())
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                                   rtol=2e-3, atol=1e-5)
+        np.testing.assert_allclose(float(aux2), float(aux1), rtol=1e-5)
+
+    def test_ep_dropless_under_total_imbalance(self, eight_devices):
+        """All tokens route to the experts of ONE ep shard — the worst-case
+        a2a load — and the default capacity still computes every pair."""
+        from deepspeed_tpu.moe import grouped_moe_mlp_block
+
+        class Cfg:
+            top_k = 2
+            moe_ep_capacity_factor = 0.0
+
+        w, hk = self._weights(jax.random.key(1))
+        # bias the router so experts 0/1 (both on ep shard 0) win everywhere
+        w["router"] = w["router"] * 0.0 + jnp.array(
+            [8.0, 7.0] + [-8.0] * 6)[None, :]
+        h = jax.random.normal(hk, (4, 16, 16))
+        y1, _ = grouped_moe_mlp_block(h, w, Cfg())
+        with jax.sharding.set_mesh(self._ep_mesh(eight_devices)):
+            y2, _ = jax.jit(grouped_moe_mlp_block, static_argnums=2)(
+                h, w, Cfg())
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                                   rtol=2e-3, atol=1e-5)
+
+    def test_ep_capacity_factor_bounds_payload(self, eight_devices):
+        """With a finite moe_ep_capacity_factor the a2a buffer shrinks and
+        overflow pairs are dropped (documented trade): output stays finite
+        and differs from the dropless result under total imbalance."""
+        from deepspeed_tpu.moe import grouped_moe_mlp_block
+
+        class Tight:
+            top_k = 2
+            moe_ep_capacity_factor = 1.0   # balanced-load capacity only
+
+        w, hk = self._weights(jax.random.key(2))
+        w["router"] = w["router"] * 0.0 + jnp.array(
+            [8.0, 7.0] + [-8.0] * 6)[None, :]
+        h = jax.random.normal(hk, (4, 16, 16))
+        y_dropless, _ = grouped_moe_mlp_block(h, w, type(
+            "C", (), {"top_k": 2, "moe_ep_capacity_factor": 0.0}))
+        with jax.sharding.set_mesh(self._ep_mesh(eight_devices)):
+            y_tight, _ = jax.jit(grouped_moe_mlp_block, static_argnums=2)(
+                h, w, Tight())
+        assert np.isfinite(np.asarray(y_tight)).all()
+        assert not np.allclose(np.asarray(y_tight), np.asarray(y_dropless))
+
+    def test_mixtral_serves_under_ep(self, eight_devices, tmp_path):
+        """Imported Mixtral generates on an ep=2 mesh with greedy decode
+        matching HF exactly — expert parallelism WITH the released routing
+        (the round-2 gap: grouped dispatch used to refuse ep>1)."""
+        import torch
+        from transformers import MixtralConfig, MixtralForCausalLM
+
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+        torch.manual_seed(0)
+        cfg = MixtralConfig(vocab_size=128, hidden_size=32,
+                            intermediate_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            num_local_experts=4, num_experts_per_tok=2,
+                            max_position_embeddings=64)
+        hf = MixtralForCausalLM(cfg)
+        hf.save_pretrained(str(tmp_path))
+        model, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+        eng = InferenceEngine(model, config={"mesh": {"ep": 2, "dp": 4}},
+                              params=params)
+        ids = np.random.default_rng(0).integers(0, 128, (4, 8))
+        out = np.asarray(eng.generate(ids, max_new_tokens=4))
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(ids), max_new_tokens=4,
+                              do_sample=False).numpy()
+        np.testing.assert_array_equal(out, ref)
+        # single request: decode steps have S=1 < ep — the pad path
+        out1 = np.asarray(eng.generate(ids[:1], max_new_tokens=4))
+        np.testing.assert_array_equal(out1[0], ref[0])
+
+    def test_ep_grouped_trains(self, eight_devices):
+        """End to end: moe_dispatch='grouped' now composes with ep>1."""
         import dataclasses
 
         import deepspeed_tpu as ds
@@ -167,10 +278,16 @@ class TestGroupedDispatch:
         cfg = dataclasses.replace(get_preset("tiny-moe"),
                                   moe_dispatch="grouped")
         model = TransformerLM(cfg, moe_fn=moe_block_for(cfg))
-        with pytest.raises(Exception, match="ep"):
-            eng, *_ = ds.initialize(model=model, config={
-                "train_micro_batch_size_per_gpu": 2,
-                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-                "zero_optimization": {"stage": 0}, "mesh": {"ep": 4, "dp": 2},
-                "steps_per_print": 100})
-            eng.forward({"input_ids": np.zeros((4, 32), np.int32)})
+        eng, *_ = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "mesh": {"ep": 4, "dp": 2},
+            "steps_per_print": 100})
+        b = {"input_ids": np.random.default_rng(0).integers(0, 256, (4, 32))}
+        losses = []
+        for _ in range(4):
+            loss = eng.forward(b)
+            eng.backward(loss)
+            eng.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
