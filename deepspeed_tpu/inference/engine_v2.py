@@ -32,7 +32,8 @@ class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params=None, max_sequences: int = 8,
                  max_seq_len: Optional[int] = None, block_size: int = 128,
                  num_blocks: Optional[int] = None, paged: bool = True,
-                 topology=None, mesh: Optional[dict] = None):
+                 packed: bool = True, topology=None,
+                 mesh: Optional[dict] = None):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from deepspeed_tpu.parallel import build_mesh
@@ -80,12 +81,15 @@ class InferenceEngineV2:
             # double-buffer the whole pool and copy all unchanged blocks
             self._step = jax.jit(model.forward_with_paged_cache,
                                  donate_argnums=(2,))
+            self._step_packed = jax.jit(model.forward_with_packed_cache,
+                                        donate_argnums=(2,))
             log_dist(f"paged KV pool: {self.num_blocks} blocks x {block_size} "
                      f"tokens ({self.cache['k'].nbytes * 2 / 1e6:.0f} MB), "
                      f"mesh={self.topology}")
         else:
             self.cache = model.init_kv_cache(max_sequences, self.max_seq_len)
             self._step = jax.jit(model.forward_with_cache)
+        self.packed = packed and paged
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
     def query(self, uid: int, n_tokens: int) -> bool:
@@ -124,8 +128,45 @@ class InferenceEngineV2:
         descs = [self.state.schedule(uid, len(toks))
                  for uid, toks in zip(batch_uids, chunks)]
 
-        t_max = max(len(c) for c in chunks)
         Bs = self.state.max_sequences
+
+        if self.packed:
+            # token-packed ragged batch (ragged_wrapper.py parity): ONE row of
+            # exactly the scheduled tokens — a mixed prefill+decode step costs
+            # FLOPs ∝ total tokens, not max_sequences × t_max. The packed
+            # length is bucketed to powers of two so the jit cache stays
+            # O(log max_batched_tokens) entries.
+            tokens = np.concatenate(chunks).astype(np.int32)
+            n = len(tokens)
+            npad = max(8, 1 << (n - 1).bit_length())
+            tok_ids = np.zeros((npad,), np.int32)
+            tok_ids[:n] = tokens
+            tok_slot = np.zeros((npad,), np.int32)
+            tok_pos = np.zeros((npad,), np.int32)
+            valid = np.zeros((npad,), bool)
+            gather_idx = np.zeros((Bs,), np.int32)
+            off = 0
+            for i, (d, c) in enumerate(zip(descs, chunks)):
+                tok_slot[off:off + len(c)] = d.slot
+                tok_pos[off:off + len(c)] = d.seen_tokens + np.arange(len(c))
+                valid[off:off + len(c)] = True
+                off += len(c)
+                gather_idx[i] = off - 1          # chunk end → next-token logits
+            with jax.sharding.set_mesh(self.mesh):
+                logits, self.cache = self._step_packed(
+                    self.params, jnp.asarray(tok_ids), self.cache,
+                    jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
+                    jnp.asarray(tok_pos), jnp.asarray(valid),
+                    jnp.asarray(gather_idx))
+                out = np.asarray(logits)
+            results: Dict[int, np.ndarray] = {}
+            for i, (d, c) in enumerate(zip(descs, chunks)):
+                results[d.uid] = out[i]
+                self._pos[d.slot] = d.seen_tokens + len(c)
+                self.state.commit(d.uid)
+            return results
+
+        t_max = max(len(c) for c in chunks)
         # dense tile: scheduled slots get their chunk (right-padded); others no-op.
         tile = np.zeros((Bs, t_max), np.int32)
         for d, c in zip(descs, chunks):
@@ -154,7 +195,11 @@ class InferenceEngineV2:
                 self.state.commit(d.uid)
             return results
 
-        logits, new_cache = self._step(self.params, jnp.asarray(tile), self.cache)
+        valid = np.zeros((Bs, t_max), bool)
+        for d, c in zip(descs, chunks):
+            valid[d.slot, :len(c)] = True
+        logits, new_cache = self._step(self.params, jnp.asarray(tile),
+                                       self.cache, jnp.asarray(valid))
         out = np.asarray(logits[jnp.asarray(slots), jnp.asarray(ends)])
         results = {}
         new_pos = np.asarray(self.cache["pos"]).copy()

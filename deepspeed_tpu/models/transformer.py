@@ -346,13 +346,19 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _decode_block(h: jax.Array, wc: Params, cfg: TransformerConfig,
                   freqs: Optional[jax.Array], positions: jax.Array,
                   attn_cache_fn: Callable,
-                  moe_fn: Optional[Callable] = None) -> jax.Array:
+                  moe_fn: Optional[Callable] = None,
+                  moe_valid: Optional[jax.Array] = None) -> jax.Array:
     """One decoder block on the decode path. ``attn_cache_fn(q, k, v)`` owns
     the cache append + attention and returns [B, t, H, hd]. Mirrors
-    :func:`transformer_block` (parallel residual, shared norm, biases, MoE)."""
+    :func:`transformer_block` (parallel residual, shared norm, biases, MoE).
+    ``moe_valid`` [B, t] marks real (non-padding/idle) lanes: without it the
+    batch's no-op rows would compete for expert capacity and skew routing."""
     def _mlp(hn):
         if moe_fn is not None:
-            return moe_fn(hn, wc["mlp"], cfg)[0]  # aux loss unused at decode
+            try:
+                return moe_fn(hn, wc["mlp"], cfg, valid=moe_valid)[0]
+            except TypeError:  # custom moe_fn without valid support
+                return moe_fn(hn, wc["mlp"], cfg)[0]  # aux unused at decode
         return mlp_block(hn, wc["mlp"], cfg)
 
     hn1 = _norm(h, wc["ln1"], cfg.norm, cfg.norm_eps)
@@ -719,8 +725,8 @@ class TransformerLM:
                 "pos": jnp.zeros((batch_size,), jnp.int32)}
 
     def forward_with_cache(self, params: Params, input_ids: jax.Array,
-                           cache: Dict[str, jax.Array]
-                           ) -> Any:
+                           cache: Dict[str, jax.Array],
+                           valid: Optional[jax.Array] = None) -> Any:
         """Prefill/decode step: append ``input_ids`` [B, t] at each sequence's
         ``cache['pos']`` and return (logits [B, t, V], updated cache).
 
@@ -759,7 +765,7 @@ class TransformerLM:
                 return _cached_attention(q, nk, nv, valid)
 
             h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
-                              self.moe_fn)
+                              self.moe_fn, moe_valid=valid)
             return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
@@ -821,13 +827,70 @@ class TransformerLM:
                                           window=cfg.sliding_window)
 
             h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
-                              self.moe_fn)
+                              self.moe_fn, moe_valid=valid)
             return h, (new_kv["k"], new_kv["v"])
 
         x, (nk, nv) = jax.lax.scan(body, x,
                                    (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x @ self._head(params).astype(dt)
+        return logits, {"k": nk, "v": nv}
+
+    def forward_with_packed_cache(self, params: Params, token_ids: jax.Array,
+                                  cache: Dict[str, jax.Array],
+                                  block_tables: jax.Array,
+                                  tok_slot: jax.Array, tok_pos: jax.Array,
+                                  valid: jax.Array,
+                                  gather_idx: jax.Array) -> Any:
+        """Token-packed continuous-batching step (ragged_wrapper.py parity).
+
+        Unlike :meth:`forward_with_paged_cache`'s dense ``[max_sequences,
+        t_max]`` tile, the batch here is ONE packed row of exactly the
+        scheduled tokens (padded to a bucket): ``token_ids`` [N] with
+        per-token ``tok_slot``/``tok_pos`` [N] metadata — a prefill chunk
+        contributes len(chunk) entries, a decode step one. Compiled FLOPs
+        therefore scale with total scheduled tokens, not
+        ``max_sequences × t_max``. Each token row attends its own sequence's
+        paged KV (per-row block tables into the Pallas kernel); logits are
+        computed only at ``gather_idx`` (the chunk ends) — the
+        ``logits_gather`` of reference ``v2/kernels/ragged_ops``.
+
+        Returns (logits [G, V], updated cache).
+        """
+        from deepspeed_tpu.ops.paged_attention import (paged_attention_tp,
+                                                       paged_update)
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        bt_packed = block_tables[tok_slot]                      # [N, nb_max]
+        positions = tok_pos[:, None]                            # [N, 1]
+        x = params["embed"]["tokens"].astype(dt)[token_ids][:, None, :]
+        if cfg.learned_pos:
+            safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+            x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        freqs = self._freqs
+
+        def body(carry, xs):
+            layer_w, kp, vp = xs
+            wc = jax.tree_util.tree_map(
+                lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
+            new_kv = {}
+
+            def attn_cache_fn(q, k, v):
+                nk = paged_update(kp, k, bt_packed, tok_pos, valid[:, None])
+                nv = paged_update(vp, v, bt_packed, tok_pos, valid[:, None])
+                new_kv["k"], new_kv["v"] = nk, nv
+                return paged_attention_tp(q, nk, nv, bt_packed, tok_pos,
+                                          window=cfg.sliding_window)
+
+            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
+                              self.moe_fn, moe_valid=valid[:, None])
+            return h, (new_kv["k"], new_kv["v"])
+
+        x, (nk, nv) = jax.lax.scan(body, x,
+                                   (params["layers"], cache["k"], cache["v"]))
+        x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
+        logits = x[gather_idx] @ self._head(params).astype(dt)   # [G, V]
         return logits, {"k": nk, "v": nv}
 
     # ---- sharding ---------------------------------------------------------

@@ -31,9 +31,17 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
 
 
 def _route(logits: jax.Array, k: int, rng: Optional[jax.Array] = None,
-           noise_std: float = 0.0):
-    """Shared router prefix for BOTH dispatch algebras: fp32 gates, GShard
-    top-1 aux loss (sharded_moe.py:184 l_aux), renormalized top-k weights."""
+           noise_std: float = 0.0, valid: Optional[jax.Array] = None,
+           psum_axis: Optional[str] = None):
+    """Shared router prefix for ALL dispatch algebras: fp32 gates, GShard
+    top-1 aux loss (sharded_moe.py:184 l_aux), renormalized top-k weights.
+
+    ``valid`` [S] masks padding/idle rows (decode-batch no-op lanes): they are
+    excluded from the aux stats and their combine weights are zeroed, so they
+    can neither shift the load-balancing loss nor occupy expert capacity.
+    ``psum_axis`` makes the aux stats global across a manual mesh axis (the
+    ep shard_map region) — psum-of-sums equals the single-shard means.
+    """
     E = logits.shape[-1]
     logits = logits.astype(jnp.float32)
     if noise_std > 0.0 and rng is not None:  # noisy_gate_policy='RSample' parity
@@ -41,27 +49,41 @@ def _route(logits: jax.Array, k: int, rng: Optional[jax.Array] = None,
     gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
     top1 = jnp.argmax(gates, axis=-1)
     mask1 = jax.nn.one_hot(top1, E, dtype=jnp.float32)
-    aux_loss = jnp.sum(jnp.mean(gates, axis=0) * jnp.mean(mask1, axis=0)) * E
+    vf = None if valid is None else valid.astype(jnp.float32)
+    g_sum = gates.sum(0) if vf is None else (gates * vf[:, None]).sum(0)
+    m_sum = mask1.sum(0) if vf is None else (mask1 * vf[:, None]).sum(0)
+    cnt = jnp.float32(logits.shape[0]) if vf is None else vf.sum()
+    if psum_axis is not None:
+        g_sum = jax.lax.psum(g_sum, psum_axis)
+        m_sum = jax.lax.psum(m_sum, psum_axis)
+        cnt = jax.lax.psum(cnt, psum_axis)
+    denom = jnp.maximum(cnt, 1.0)
+    aux_loss = jnp.sum(g_sum * m_sum) / (denom * denom) * E
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
     # renormalize the kept gate mass (reference normalizes combine weights)
     topk_vals = topk_vals / jnp.maximum(topk_vals.sum(-1, keepdims=True), 1e-9)
+    if valid is not None:
+        topk_vals = topk_vals * valid[:, None].astype(topk_vals.dtype)
     return gates, aux_loss, topk_vals, topk_idx
 
 
 def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
                 min_capacity: int = 4, rng: Optional[jax.Array] = None,
-                noise_std: float = 0.0
+                noise_std: float = 0.0, valid: Optional[jax.Array] = None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
     """GShard top-k gating with per-expert capacity.
 
     Args:
         logits: [S, E] raw router outputs (fp32 recommended).
+        valid: [S] bool — False rows (decode-batch padding/idle lanes) do not
+            compete for expert capacity and carry zero combine weight.
     Returns:
         (dispatch [S, E, C] float, combine [S, E, C] float, aux_loss scalar, stats)
     """
     S, E = logits.shape
     C = _capacity(S, E, capacity_factor, min_capacity)
-    _gates, aux_loss, topk_vals, topk_idx = _route(logits, k, rng, noise_std)
+    _gates, aux_loss, topk_vals, topk_idx = _route(logits, k, rng, noise_std,
+                                                   valid=valid)
 
     dispatch = jnp.zeros((S, E, C), jnp.float32)
     combine = jnp.zeros((S, E, C), jnp.float32)
@@ -69,6 +91,8 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
     for j in range(k):
         idx_j = topk_idx[:, j]                       # [S]
         mask_j = jax.nn.one_hot(idx_j, E, dtype=jnp.int32)   # [S, E]
+        if valid is not None:
+            mask_j = mask_j * valid[:, None].astype(jnp.int32)
         pos_in_expert = jnp.cumsum(mask_j, axis=0) - mask_j  # position among j-th picks
         loc = jnp.sum(pos_in_expert * mask_j, axis=1) + counts[idx_j]  # [S]
         keep = loc < C
@@ -88,12 +112,15 @@ def top1_gating(logits: jax.Array, **kw):
     return topk_gating(logits, k=1, **kw)
 
 
-def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
+def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
+                  valid: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array]:
     """Drop-in MoE MLP for ``TransformerLM`` (the ``moe_fn`` hook in
     ``models/transformer.py`` ``transformer_block``).
 
-    h: [B, T, D]; w: router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D].
+    h: [B, T, D]; w: router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D];
+    valid: optional [B, T] bool — padding/idle decode lanes that must not
+    consume expert capacity or shift the aux stats.
     """
     B, T, D = h.shape
     E = w["router"].shape[-1]
@@ -101,7 +128,8 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
     logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)
     dispatch, combine, aux, _ = topk_gating(
         logits, k=cfg.top_k, capacity_factor=cfg.capacity_factor,
-        min_capacity=getattr(cfg, "min_capacity", 4))
+        min_capacity=getattr(cfg, "min_capacity", 4),
+        valid=None if valid is None else valid.reshape(-1))
 
     dt = h.dtype
     xe = jnp.einsum("sec,sd->ecd", dispatch.astype(dt), x)       # [E, C, D]
@@ -132,7 +160,8 @@ def _grouped_ffn(xs: jax.Array, group_sizes: jax.Array, w: Dict[str, jax.Array],
     return jax.lax.ragged_dot(act, w["w_down"].astype(dt), group_sizes)
 
 
-def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
+def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
+                          valid: Optional[jax.Array] = None
                           ) -> Tuple[jax.Array, jax.Array]:
     """Dropless sort-based dispatch over grouped GEMMs — the
     ``inference/v2/kernels/cutlass_ops/moe_gemm`` (MegaBlocks-style) analog,
@@ -144,19 +173,22 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
     Under ``ep > 1`` dispatch routes through ``_grouped_moe_ep`` — an explicit
     padded all-to-all over the ``ep`` axis feeding per-shard grouped GEMMs (the
     ``_AllToAll`` of reference ``moe/sharded_moe.py:97``, made dropless).
+    ``valid`` [B, T] masks padding/idle decode lanes out of the aux stats and
+    combine weights.
     """
     mesh = jax.sharding.get_abstract_mesh()
     if (mesh is not None and not mesh.empty and "ep" in mesh.axis_names
             and mesh.shape["ep"] > 1
             and "ep" not in set(getattr(mesh, "manual_axes", ()) or ())):
-        return _grouped_moe_ep(h, w, cfg, mesh)
+        return _grouped_moe_ep(h, w, cfg, mesh, valid)
     B, T, D = h.shape
     E = w["router"].shape[-1]
     k = cfg.top_k
     x = h.reshape(B * T, D)
     S = x.shape[0]
     logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)
-    _gates, aux_loss, topk_vals, topk_idx = _route(logits, k)
+    _gates, aux_loss, topk_vals, topk_idx = _route(
+        logits, k, valid=None if valid is None else valid.reshape(-1))
 
     flat_expert = topk_idx.reshape(-1)                        # [S*k]
     order = jnp.argsort(flat_expert)                          # group by expert
@@ -172,7 +204,8 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any
 
 
 def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
-                    mesh) -> Tuple[jax.Array, jax.Array]:
+                    mesh, valid: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
     """Expert-parallel dropless dispatch: tokens resharded over ``ep``, routed
     through a capacity-padded ``all_to_all`` to the shard owning each expert,
     run through the local grouped GEMM, and returned by the mirror a2a.
@@ -213,27 +246,24 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
         cap = s_local * k
     dt = h.dtype
 
-    def shard(x, router, wl):
+    def shard(x, vrow, router, wl):
         my = jax.lax.axis_index("ep")
-        # pad-row mask: rows at global index >= S are padding
-        real = (my * s_local + jnp.arange(s_local)) < S        # [S_l]
+        # row mask: caller's valid lanes minus the up-to-ep padding rows
+        real = ((my * s_local + jnp.arange(s_local)) < S) & vrow  # [S_l]
         logits = x.astype(jnp.float32) @ router
-        gates = jax.nn.softmax(logits, axis=-1)                # [S_l, E]
-        mask1 = jax.nn.one_hot(jnp.argmax(gates, -1), E, dtype=jnp.float32)
-        rf = real[:, None].astype(jnp.float32)
-        # global-batch aux loss: psum-of-sums == the ep=1 _route() means
-        g_mean = jax.lax.psum((gates * rf).sum(0), "ep") / S
-        m_mean = jax.lax.psum((mask1 * rf).sum(0), "ep") / S
-        aux = jnp.sum(g_mean * m_mean) * E
-        topk_vals, topk_idx = jax.lax.top_k(gates, k)          # [S_l, k]
-        topk_vals = topk_vals / jnp.maximum(
-            topk_vals.sum(-1, keepdims=True), 1e-9)
+        _gates, aux, topk_vals, topk_idx = _route(logits, k, valid=real,
+                                                  psum_axis="ep")
 
         n = s_local * k
         flat_e = topk_idx.reshape(-1)                          # [n]
         dest = flat_e // e_local                               # owning ep shard
-        oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32)
+        real_pairs = jnp.repeat(real, k)                       # [n]
+        oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32) \
+            * real_pairs[:, None].astype(jnp.int32)
         slot = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=1)  # per-dest pos
+        # invalid rows never occupy an a2a slot (they would otherwise evict
+        # real pairs under a finite moe_ep_capacity_factor)
+        slot = jnp.where(real_pairs, slot, cap)
         tok = jnp.arange(n) // k
         # expert id rides the activation payload as two bf16-exact lanes
         # (hi/lo base-128 digits of flat_e+1; 0 = empty slot) — one a2a, not two
@@ -261,8 +291,7 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
             "ep", 0, 0, tiled=True)
 
         keep = (slot < cap).astype(dt)                         # 1 unless factor drops
-        wgt = topk_vals.reshape(-1).astype(dt) * keep \
-            * jnp.repeat(real, k).astype(dt)
+        wgt = topk_vals.reshape(-1).astype(dt) * keep          # invalid rows: 0
         y_pair = y_back[dest, jnp.minimum(slot, cap - 1)]      # [n, D]
         out = jnp.zeros((s_local, D), dt).at[tok].add(y_pair * wgt[:, None])
         return out, aux
@@ -270,17 +299,20 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     ew = P("ep", None, None)
     experts = {n: v for n, v in w.items() if n != "router"}
     x2 = h.reshape(S, D)
+    v2 = (jnp.ones((S,), bool) if valid is None else valid.reshape(S))
     if s_pad != S:
         x2 = jnp.concatenate([x2, jnp.zeros((s_pad - S, D), x2.dtype)], axis=0)
+        v2 = jnp.concatenate([v2, jnp.zeros((s_pad - S,), bool)], axis=0)
     # router enters replicated-over-ep in fp32: its cotangent is a psum over
     # ep, and a *bf16* replicated-in grad trips an XLA:CPU check failure in
     # AllReducePromotion (all-reduce with copy reduction); fp32 sidesteps it
     # and is what _route computes in anyway.
     out2, aux = jax.shard_map(
         shard, mesh=mesh,
-        in_specs=(P("ep", None), P(None, None), {n: ew for n in experts}),
+        in_specs=(P("ep", None), P("ep"), P(None, None),
+                  {n: ew for n in experts}),
         out_specs=(P("ep", None), P()), axis_names={"ep"},
-        check_vma=False)(x2, w["router"].astype(jnp.float32), experts)
+        check_vma=False)(x2, v2, w["router"].astype(jnp.float32), experts)
     if s_pad != S:
         # the sliced-off-pad result has no expressible ep sharding — pin it
         # replicated (pad only occurs at decode-sized S, where this is cheap)

@@ -241,3 +241,91 @@ def test_windowed_family_serves_through_paged_engine():
             params, np.asarray(seq)[None].astype(np.int32)), np.float32)
         np.testing.assert_allclose(np.asarray(r[1], np.float32),
                                    full[0, -1], atol=3e-2)
+
+
+def test_packed_matches_tile_engine(tiny_lm):
+    """The token-packed ragged step must reproduce the dense-tile paged step
+    across interleaved prefill/decode scheduling (round-2 gap #2)."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, 256, 7)
+    p2 = rng.integers(0, 256, 5)
+    e_packed = InferenceEngineV2(model, params=params, max_sequences=4,
+                                 max_seq_len=32, block_size=8, packed=True)
+    e_tile = InferenceEngineV2(model, params=params, max_sequences=4,
+                               max_seq_len=32, block_size=8, packed=False)
+    assert e_packed.packed and not e_tile.packed
+    for eng in (e_packed, e_tile):
+        r1 = eng.put([1], [p1])
+        r2 = eng.put([2, 1], [p2, np.array([7])])
+        r3 = eng.put([1, 2], [np.array([3]), np.array([11])])
+        eng._r = (r1, r2, r3)
+    for a, b in zip(e_packed._r, e_tile._r):
+        for uid in a:
+            np.testing.assert_allclose(np.asarray(a[uid], np.float32),
+                                       np.asarray(b[uid], np.float32),
+                                       atol=3e-2)
+
+
+def test_packed_flops_scale_with_tokens(tiny_lm):
+    """A mixed prefill+decode step's compiled FLOPs must follow total
+    scheduled tokens, not max_sequences × t_max: one 64-token prefill + 7
+    decodes packs into 128 token rows vs the 8×64 dense tile (4× the rows) —
+    reference ragged_wrapper.py packs exactly total_tokens."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.profiling import profile_fn
+
+    model, params = tiny_lm
+    Bs, t_max, bsz = 8, 64, 8
+    nb_max = 64 // bsz
+    cache = model.init_paged_kv_cache(Bs * nb_max, bsz)
+    bt = np.arange(Bs * nb_max, dtype=np.int32).reshape(Bs, nb_max)
+
+    # dense tile: [8, 64] rows
+    tile = np.zeros((Bs, t_max), np.int32)
+    pos = np.zeros((Bs,), np.int32)
+    valid_t = np.zeros((Bs, t_max), bool)
+    valid_t[0] = True
+    valid_t[1:, 0] = True
+    tile_cost = profile_fn(model.forward_with_paged_cache, params,
+                           jnp.asarray(tile), cache, jnp.asarray(bt),
+                           jnp.asarray(pos), jnp.asarray(valid_t))
+
+    # packed: 64 + 7 = 71 tokens → 128 bucket
+    npad = 128
+    tok_ids = np.zeros((npad,), np.int32)
+    tok_slot = np.zeros((npad,), np.int32)
+    tok_pos = np.zeros((npad,), np.int32)
+    valid_p = np.zeros((npad,), bool)
+    tok_slot[64:71] = np.arange(1, 8)
+    tok_pos[:64] = np.arange(64)
+    valid_p[:71] = True
+    gather = np.zeros((Bs,), np.int32)
+    packed_cost = profile_fn(model.forward_with_packed_cache, params,
+                             jnp.asarray(tok_ids), cache, jnp.asarray(bt),
+                             jnp.asarray(tok_slot), jnp.asarray(tok_pos),
+                             jnp.asarray(valid_p), jnp.asarray(gather))
+    assert packed_cost["flops"] > 0 and tile_cost["flops"] > 0
+    # 128 packed rows vs 512 tile rows + per-row logits head → well under half
+    assert packed_cost["flops"] < 0.5 * tile_cost["flops"], (
+        packed_cost, tile_cost)
+
+
+def test_packed_jit_cache_bounded(tiny_lm):
+    """Power-of-two bucketing keeps the packed step's jit cache at
+    O(log max_batched_tokens) entries regardless of chunk-length variety."""
+    model, params = tiny_lm
+    eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                            max_seq_len=64, block_size=8)
+    rng = np.random.default_rng(8)
+    for uid, n in enumerate([3, 5, 7, 6]):        # all bucket to 8
+        eng.put([uid], [rng.integers(0, 256, n)])
+    for uid in range(4):                           # 4 decodes → 8 bucket too
+        eng.put([uid], [np.array([uid + 1])])
+    eng.put([0, 1], [rng.integers(0, 256, 9), np.array([2])])  # 10 → 16
+    # 2 buckets (8, 16) + 1: the first call's freshly-placed cache signs
+    # differently from the steady-state donated cache (one extra trace-cache
+    # entry, no extra XLA compile)
+    assert eng._step_packed._cache_size() <= 3, \
+        eng._step_packed._cache_size()

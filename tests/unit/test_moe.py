@@ -291,3 +291,39 @@ class TestGroupedEP:
             eng.step()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+def test_capacity_moe_decode_ignores_idle_lanes(eight_devices):
+    """A capacity-dispatch MoE model served with a mostly-empty batch must
+    match the solo reference: pad/idle lanes are masked out of expert
+    capacity competition. The real sequence is placed in a LATE slot so the
+    idle lanes (all embedding token 0 — identical router picks) precede it in
+    the capacity cumsum; without the valid mask they would fill the experts'
+    capacity and evict the real tokens' assignments. A 4-token prompt keeps
+    every path inside min_capacity, so any post-fix mismatch is eviction,
+    not the (inherent) capacity-vs-batch-shape difference."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+
+    cfg = get_preset("tiny-moe")  # moe_dispatch='capacity'
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(9)
+    p = rng.integers(0, 256, 4)
+
+    # solo reference through a batch-of-one dense cache
+    cache = model.init_kv_cache(1, 32)
+    lg, _ = model.forward_with_cache(params, p[None].astype(np.int32), cache)
+    ref = np.asarray(lg[0, -1], np.float32)
+
+    for packed in (True, False):
+        eng = InferenceEngineV2(model, params=params, max_sequences=8,
+                                max_seq_len=32, block_size=8, packed=packed)
+        # burn slots 0-3 then free 0-2: uid 5 lands in slot 4 with four
+        # idle-lane slots ahead of it in row order
+        for uid in (1, 2, 3, 4):
+            eng.put([uid], [rng.integers(0, 256, 4)])
+        eng.flush([1, 2, 3])
+        r = eng.put([5], [p])
+        assert eng.state.sequences[5].slot == 4
+        np.testing.assert_allclose(np.asarray(r[5], np.float32), ref,
+                                   atol=3e-2)
