@@ -113,6 +113,19 @@ def main() -> None:
             "batch": batch, "ga": ga, "seq": seq, "steps": steps,
         },
     }
+
+    # serving numbers (FastGen parity: decode/prefill tokens/s) ride along
+    # under extra.inference; DSTPU_BENCH_INFERENCE=0 skips them
+    import os
+
+    if os.environ.get("DSTPU_BENCH_INFERENCE", "1") != "0":
+        try:
+            from bench_infer import run_inference_bench
+
+            result["extra"]["inference"] = run_inference_bench()
+        except Exception as e:  # serving bench must never sink the headline
+            result["extra"]["inference"] = {"error": str(e)[:200]}
+
     print(json.dumps(result))
 
 

@@ -27,6 +27,10 @@ from deepspeed_tpu.inference.ragged import SequenceManager
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.utils.logging import log_dist
 
+# packed-row atom layout (atom_builder parity): 1-token chunks are decode
+# atoms; longer chunks each occupy one whole-chunk atom of bucketed width
+_MIN_TILE = 32
+
 
 class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params=None, max_sequences: int = 8,
@@ -58,12 +62,23 @@ class InferenceEngineV2:
             jax.eval_shape(model.init, jax.random.key(0)), specs, self.topology,
             stage=0)
         self.param_sharding = shd.named(self.topology, spec_tree)
+        cdt = jnp.dtype(self.cfg.dtype)
+
+        def _serve_cast(tree):
+            # inference holds weights in the compute dtype: fp32 masters would
+            # otherwise be re-read AND re-cast every step (3x the HBM traffic
+            # of the matmuls themselves on a bf16 model)
+            return jax.tree_util.tree_map(
+                lambda p: p.astype(cdt) if p.dtype == jnp.float32 else p, tree)
+
         with jax.sharding.set_mesh(self.mesh):
             if params is None:
-                params = jax.jit(model.init,
-                                 out_shardings=self.param_sharding)(jax.random.key(0))
+                params = jax.jit(
+                    lambda k: _serve_cast(model.init(k)),
+                    out_shardings=self.param_sharding)(jax.random.key(0))
             else:
-                params = jax.device_put(params, self.param_sharding)
+                params = jax.jit(_serve_cast,
+                                 out_shardings=self.param_sharding)(params)
         self.params = params
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
@@ -76,13 +91,25 @@ class InferenceEngineV2:
             self.cache = jax.device_put(
                 cache, NamedSharding(self.mesh, kv_spec))
             self._pos = np.zeros((max_sequences,), np.int32)
+            # pin the output cache to the SAME sharding as the input: an
+            # XLA-chosen output spec would change the next call's signature
+            # and retrace/recompile every step program once per alternation
+            kv_out = {"k": NamedSharding(self.mesh, kv_spec),
+                      "v": NamedSharding(self.mesh, kv_spec)}
             # donate the pool: the step returns the updated {'k','v'} dict and
             # self.cache is immediately reassigned — without donation XLA would
             # double-buffer the whole pool and copy all unchanged blocks
             self._step = jax.jit(model.forward_with_paged_cache,
-                                 donate_argnums=(2,))
+                                 donate_argnums=(2,),
+                                 out_shardings=(None, kv_out))
             self._step_packed = jax.jit(model.forward_with_packed_cache,
-                                        donate_argnums=(2,))
+                                        donate_argnums=(2,),
+                                        static_argnums=(8, 9),
+                                        out_shardings=(None, kv_out))
+            self._decode_loop = jax.jit(self._multi_decode,
+                                        donate_argnums=(1,),
+                                        static_argnums=(6,),
+                                        out_shardings=(None, kv_out))
             log_dist(f"paged KV pool: {self.num_blocks} blocks x {block_size} "
                      f"tokens ({self.cache['k'].nbytes * 2 / 1e6:.0f} MB), "
                      f"mesh={self.topology}")
@@ -113,6 +140,66 @@ class InferenceEngineV2:
             bt[seq.slot, :len(seq.blocks)] = seq.blocks
         return bt
 
+    def _multi_decode(self, params, cache, bt, slots, pos0, tok0, steps: int,
+                      valid=None):
+        """``steps`` greedy decode iterations fused into ONE device program
+        (lax.scan): the TPU analog of the reference v1 engine's CUDA-graph
+        replay (inference/engine.py:497) — per-step host dispatch and
+        transfers vanish, so decode throughput reflects the chip. ``valid``
+        masks bucket-padding rows (decode_batch pads B to powers of two so a
+        draining batch does not recompile the scan per occupancy)."""
+        import jax.numpy as jnp
+
+        B = tok0.shape[0]
+        if valid is None:
+            valid = jnp.ones((B,), bool)
+        gather = jnp.arange(B, dtype=jnp.int32)
+
+        def step(carry, _):
+            cache, pos, toks = carry
+            logits, cache = self.module.forward_with_packed_cache(
+                params, toks, cache, bt, slots, pos, valid, gather,
+                decode_rows=B)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, pos + 1, nxt), nxt
+
+        (cache, _, _), out = jax.lax.scan(step, (cache, pos0, tok0), None,
+                                          length=steps)
+        return out, cache                     # out: [steps, B]
+
+    def decode_batch(self, batch_uids: Sequence[int],
+                     batch_tokens: Sequence[int], steps: int
+                     ) -> Dict[int, np.ndarray]:
+        """Advance every listed sequence ``steps`` tokens by on-device greedy
+        decode, starting from each sequence's ``batch_tokens`` entry. Returns
+        the generated tokens per uid ([steps] each). One dispatch + one fetch
+        regardless of ``steps`` — the throughput serving mode."""
+        if not (self.paged and self.packed):
+            raise ValueError("decode_batch needs the packed paged engine")
+        for uid in batch_uids:
+            if not self.state.can_schedule(uid, steps):
+                raise RuntimeError(f"cannot schedule uid={uid} (+{steps})")
+        descs = [self.state.schedule(uid, steps) for uid in batch_uids]
+        B = len(descs)
+        bpad = max(8, 1 << (B - 1).bit_length())  # bounded jit cache as B drains
+        slots = np.zeros((bpad,), np.int32)
+        slots[:B] = [d.slot for d in descs]
+        pos0 = np.zeros((bpad,), np.int32)
+        pos0[:B] = self._pos[slots[:B]]
+        tok0 = np.zeros((bpad,), np.int32)
+        tok0[:B] = np.asarray(batch_tokens, np.int32).reshape(B)
+        valid = np.arange(bpad) < B
+        with jax.sharding.set_mesh(self.mesh):
+            out, self.cache = self._decode_loop(
+                self.params, self.cache, jnp.asarray(self._block_tables()),
+                jnp.asarray(slots), jnp.asarray(pos0), jnp.asarray(tok0),
+                steps, jnp.asarray(valid))
+            toks = np.asarray(out)            # [steps, bpad]
+        for i, d in enumerate(descs):
+            self._pos[d.slot] = d.seen_tokens + steps
+            self.state.commit(d.uid)
+        return {d.uid: toks[:, i] for i, d in enumerate(descs)}
+
     # ---- one continuous-batching step (engine_v2.py:107 parity) ----------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
             ) -> Dict[int, np.ndarray]:
@@ -122,6 +209,21 @@ class InferenceEngineV2:
         ragged in effect while dense in shape."""
         assert len(batch_uids) == len(batch_tokens)
         chunks = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if self.packed:
+            # chunked prefill (FastGen scheduling behavior): prompts longer
+            # than one atom are fed in MAX_ATOM slices over internal steps.
+            # Capacity is checked for the WHOLE prompt first — a mid-prompt
+            # failure would otherwise leave the sequence half-prefilled.
+            cap = self.module.MAX_ATOM
+            for uid, c in zip(batch_uids, chunks):
+                if len(c) > cap and not self.state.can_schedule(uid, len(c)):
+                    raise RuntimeError(
+                        f"cannot schedule uid={uid} (+{len(c)} tokens)")
+            while any(len(c) > cap for c in chunks):
+                sel = [(u, c[:cap]) for u, c in zip(batch_uids, chunks)
+                       if len(c) > cap]
+                self.put([u for u, _ in sel], [c for _, c in sel])
+                chunks = [c[cap:] if len(c) > cap else c for c in chunks]
         for uid, toks in zip(batch_uids, chunks):
             if not self.state.can_schedule(uid, len(toks)):
                 raise RuntimeError(f"cannot schedule uid={uid} (+{len(toks)} tokens)")
@@ -131,33 +233,52 @@ class InferenceEngineV2:
         Bs = self.state.max_sequences
 
         if self.packed:
-            # token-packed ragged batch (ragged_wrapper.py parity): ONE row of
-            # exactly the scheduled tokens — a mixed prefill+decode step costs
-            # FLOPs ∝ total tokens, not max_sequences × t_max. The packed
-            # length is bucketed to powers of two so the jit cache stays
-            # O(log max_batched_tokens) entries.
-            tokens = np.concatenate(chunks).astype(np.int32)
-            n = len(tokens)
-            npad = max(8, 1 << (n - 1).bit_length())
+            # token-packed ragged batch (ragged_wrapper.py/atom_builder
+            # parity): one row of the scheduled tokens in two regions —
+            # decode steps as 1-token atoms, every longer chunk as ONE
+            # whole-chunk atom (its KV blocks are DMA'd once; its own tokens
+            # attend from VMEM so the step's appends hoist out of the layer
+            # scan). Region sizes and the atom width are bucketed to powers
+            # of two so the jit cache stays O(log^2) entries.
+            items = list(enumerate(zip(descs, chunks)))
+            dec = [(i, d, c) for i, (d, c) in items if len(c) == 1]
+            big = [(i, d, c) for i, (d, c) in items if len(c) > 1]
+            n_dec = len(dec)
+            dr = max(8, 1 << (n_dec - 1).bit_length()) if n_dec else 0
+            if big:
+                longest = max(len(c) for _, _, c in big)
+                tile = max(_MIN_TILE, 1 << (longest - 1).bit_length())
+                tpad = 1 << (len(big) - 1).bit_length()
+            else:
+                tile, tpad = self.module.MAX_ATOM, 0
+            npad = dr + tpad * tile
             tok_ids = np.zeros((npad,), np.int32)
-            tok_ids[:n] = tokens
             tok_slot = np.zeros((npad,), np.int32)
             tok_pos = np.zeros((npad,), np.int32)
             valid = np.zeros((npad,), bool)
             gather_idx = np.zeros((Bs,), np.int32)
             off = 0
-            for i, (d, c) in enumerate(zip(descs, chunks)):
-                tok_slot[off:off + len(c)] = d.slot
+            for i, d, c in dec:
+                tok_ids[off] = c[0]
+                tok_slot[off] = d.slot
+                tok_pos[off] = d.seen_tokens
+                valid[off] = True
+                gather_idx[i] = off              # chunk end → next-token logits
+                off += 1
+            off = dr
+            for i, d, c in big:                  # one whole-chunk atom each
+                tok_ids[off:off + len(c)] = c
+                tok_slot[off:off + tile] = d.slot
                 tok_pos[off:off + len(c)] = d.seen_tokens + np.arange(len(c))
                 valid[off:off + len(c)] = True
-                off += len(c)
-                gather_idx[i] = off - 1          # chunk end → next-token logits
+                gather_idx[i] = off + len(c) - 1
+                off += tile
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step_packed(
                     self.params, jnp.asarray(tok_ids), self.cache,
                     jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
                     jnp.asarray(tok_pos), jnp.asarray(valid),
-                    jnp.asarray(gather_idx))
+                    jnp.asarray(gather_idx), dr, tile)
                 out = np.asarray(logits)
             results: Dict[int, np.ndarray] = {}
             for i, (d, c) in enumerate(zip(descs, chunks)):

@@ -836,39 +836,66 @@ class TransformerLM:
         logits = x @ self._head(params).astype(dt)
         return logits, {"k": nk, "v": nv}
 
+    MAX_ATOM = 256   # widest prefill atom (VMEM-bounded); engines chunk longer prompts
+
     def forward_with_packed_cache(self, params: Params, token_ids: jax.Array,
                                   cache: Dict[str, jax.Array],
                                   block_tables: jax.Array,
                                   tok_slot: jax.Array, tok_pos: jax.Array,
                                   valid: jax.Array,
-                                  gather_idx: jax.Array) -> Any:
+                                  gather_idx: jax.Array,
+                                  decode_rows: Optional[int] = None,
+                                  tile_tq: int = 128) -> Any:
         """Token-packed continuous-batching step (ragged_wrapper.py parity).
 
         Unlike :meth:`forward_with_paged_cache`'s dense ``[max_sequences,
-        t_max]`` tile, the batch here is ONE packed row of exactly the
-        scheduled tokens (padded to a bucket): ``token_ids`` [N] with
-        per-token ``tok_slot``/``tok_pos`` [N] metadata — a prefill chunk
-        contributes len(chunk) entries, a decode step one. Compiled FLOPs
-        therefore scale with total scheduled tokens, not
-        ``max_sequences × t_max``. Each token row attends its own sequence's
-        paged KV (per-row block tables into the Pallas kernel); logits are
-        computed only at ``gather_idx`` (the chunk ends) — the
-        ``logits_gather`` of reference ``v2/kernels/ragged_ops``.
+        t_max]`` tile, the batch here is ONE packed row of the scheduled
+        tokens: ``token_ids`` [N] with per-token ``tok_slot``/``tok_pos``
+        metadata, laid out in two regions (the atom layout of reference
+        ``v2/kernels/ragged_ops/atom_builder``):
+
+        * rows ``[0, decode_rows)`` — 1-token atoms (decode steps);
+        * rows ``[decode_rows, N)`` — ``tile_tq``-wide atoms, each holding
+          ONE whole chunk (consecutive tokens of one sequence, right-padded;
+          chunks longer than :attr:`MAX_ATOM` are chunked across put()s).
+
+        Attention runs in the manual-DMA Pallas kernel: every atom reads its
+        own tokens' KV from VMEM and streams only PAST put()s' blocks from
+        the pool, so all layers' KV appends hoist into one in-place scatter
+        after the layer scan (``packed_kv_append``) instead of a per-layer
+        pool copy. ``decode_rows=None`` treats every row as a 1-token atom
+        (valid only when every chunk has length 1). Logits are computed only
+        at ``gather_idx`` (chunk ends) — reference ``logits_gather``.
 
         Returns (logits [G, V], updated cache).
         """
-        from deepspeed_tpu.ops.paged_attention import (paged_attention_tp,
-                                                       paged_update)
+        from deepspeed_tpu.ops.paged_attention import (
+            packed_kv_append, ragged_paged_attention_tp)
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
-        bt_packed = block_tables[tok_slot]                      # [N, nb_max]
+        N = token_ids.shape[0]
+        dr = N if decode_rows is None else decode_rows
+        if (N - dr) % tile_tq:
+            raise ValueError(f"prefill region ({N} - {dr} rows) must be a "
+                             f"multiple of the {tile_tq}-token atom tile")
+        n_tiles = (N - dr) // tile_tq
         positions = tok_pos[:, None]                            # [N, 1]
         x = params["embed"]["tokens"].astype(dt)[token_ids][:, None, :]
         if cfg.learned_pos:
             safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
             x = x + params["embed"]["pos"][safe_pos].astype(dt)
         freqs = self._freqs
+
+        # atom metadata (decode rows: 1-token atoms; tiles: first-row
+        # slot/pos + count of real rows)
+        a_slot_d, a_pos_d = tok_slot[:dr], tok_pos[:dr]
+        a_len_d = valid[:dr].astype(jnp.int32)
+        if n_tiles:
+            a_slot_t = tok_slot[dr::tile_tq]
+            a_pos_t = tok_pos[dr::tile_tq]
+            a_len_t = valid[dr:].reshape(n_tiles, tile_tq).sum(
+                axis=1, dtype=jnp.int32)
 
         def body(carry, xs):
             layer_w, kp, vp = xs
@@ -877,18 +904,32 @@ class TransformerLM:
             new_kv = {}
 
             def attn_cache_fn(q, k, v):
-                nk = paged_update(kp, k, bt_packed, tok_pos, valid[:, None])
-                nv = paged_update(vp, v, bt_packed, tok_pos, valid[:, None])
-                new_kv["k"], new_kv["v"] = nk, nv
-                return paged_attention_tp(q, nk, nv, bt_packed, tok_pos,
-                                          window=cfg.sliding_window)
+                q2, k2, v2 = q[:, 0], k[:, 0], v[:, 0]          # [N, H|K, d]
+                new_kv["k"], new_kv["v"] = k2, v2  # appended after the scan
+                parts = []
+                if dr:
+                    parts.append(ragged_paged_attention_tp(
+                        q2[:dr], k2[:dr], v2[:dr], kp, vp, block_tables,
+                        a_slot_d, a_pos_d, a_len_d, tq=1,
+                        window=cfg.sliding_window))
+                if n_tiles:
+                    parts.append(ragged_paged_attention_tp(
+                        q2[dr:], k2[dr:], v2[dr:], kp, vp, block_tables,
+                        a_slot_t, a_pos_t, a_len_t, tq=tile_tq,
+                        window=cfg.sliding_window))
+                out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                return out[:, None]                             # [N, 1, H, d]
 
             h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
                               self.moe_fn, moe_valid=valid[:, None])
             return h, (new_kv["k"], new_kv["v"])
 
-        x, (nk, nv) = jax.lax.scan(body, x,
-                                   (params["layers"], cache["k"], cache["v"]))
+        x, (krows, vrows) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        nk = packed_kv_append(cache["k"], krows, block_tables, tok_slot,
+                              tok_pos, valid)
+        nv = packed_kv_append(cache["v"], vrows, block_tables, tok_slot,
+                              tok_pos, valid)
         x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x[gather_idx] @ self._head(params).astype(dt)   # [G, V]
         return logits, {"k": nk, "v": nv}

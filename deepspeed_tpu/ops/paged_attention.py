@@ -238,6 +238,335 @@ def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     )(q, k_pool, v_pool, block_tables, pos)
 
 
+# ---------------------------------------------------------------------------
+# Ragged atom kernels (FastGen atom_builder/blocked_flash parity, decode-fast)
+#
+# The grid-per-(row, head, block) kernel above re-fetches each KV block once
+# per query row — O(T^2/bs) HBM traffic for prefill chunks — and pays a full
+# pool transpose plus a per-layer pool copy (the scan cannot alias the
+# scatter) per step. The kernels below are the serving-throughput path:
+#
+# * atom = one whole scheduled chunk (decode step = 1-token atom, prefill
+#   chunk = up to MAX_ATOM tokens; longer prompts are chunked across put()s);
+# * ONE grid step per atom: all heads computed inside the step, past-put KV
+#   blocks streamed from the raw pool layout by double-buffered manual DMA
+#   (each block fetched once per atom), and the atom attends its OWN tokens
+#   straight from VMEM — so the current step's pool writes are NOT needed by
+#   its attention, and the model hoists all layers' KV appends into one
+#   in-place scatter after the layer scan (free under buffer donation);
+# * the (K, d) axes are folded to K*d lanes at the kernel boundary: every
+#   DMA chunk is a [bs, K*d] tile — sub-tile row DMAs crash the Mosaic
+#   toolchain and tiny-sublane chunks are slow.
+# ---------------------------------------------------------------------------
+
+# (the atom-width cap lives on TransformerLM.MAX_ATOM — the engine chunking
+# and the VMEM-bounded kernel tile share that single constant)
+
+
+def _ragged_kernel(slot_ref, pos0_ref, len_ref, bt_ref, q_ref, ks_ref, vs_ref,
+                   kpool, vpool, o_ref, kbuf, vbuf, dsem, m_scr, l_scr,
+                   acc_scr, *, scale: float, bs: int, tq: int, K: int,
+                   rep: int, nb_max: int, window):
+    a = pl.program_id(0)
+    pos0 = pos0_ref[a]
+    alen = len_ref[a]
+    slot = slot_ref[a]
+    R = tq * rep
+    d = q_ref.shape[-1]
+
+    @pl.when(alen > 0)
+    def _atom():
+        q = q_ref[:].reshape(tq, K, rep, d)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        # ---- intra-atom causal attention from VMEM (the atom's own KV) ----
+        if tq == 1:
+            # decode atom: the only intra token is the row itself — Mosaic
+            # cannot lower N=1 matmuls, so use elementwise forms
+            for kk in range(K):
+                qk = q[:, kk].reshape(R, d)
+                ks_row = ks_ref[0, :, kk * d:(kk + 1) * d].astype(jnp.float32)
+                s = jnp.sum(qk.astype(jnp.float32) * ks_row, axis=1,
+                            keepdims=True) * scale               # [R, 1]
+                m_scr[kk] = jnp.broadcast_to(s, m_scr.shape[1:])
+                l_scr[kk] = jnp.ones_like(l_scr[kk])
+                acc_scr[kk] = jnp.broadcast_to(
+                    vs_ref[0, :, kk * d:(kk + 1) * d].astype(jnp.float32),
+                    acc_scr.shape[1:])
+        else:
+            row_tok = jax.lax.broadcasted_iota(jnp.int32, (R, tq), 0) // rep
+            col_tok = jax.lax.broadcasted_iota(jnp.int32, (R, tq), 1)
+            keep_i = (col_tok <= row_tok) & (col_tok < alen) & (row_tok < alen)
+            if window is not None:
+                keep_i = keep_i & (col_tok > row_tok - window)
+            for kk in range(K):
+                qk = q[:, kk].reshape(R, d)
+                s = jax.lax.dot_general(
+                    qk, ks_ref[0, :, kk * d:(kk + 1) * d],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale  # [R, tq]
+                s = jnp.where(keep_i, s, NEG_INF)
+                m_new = jnp.max(s, 1, keepdims=True)
+                p = jnp.exp(s - m_new)
+                l_scr[kk] = jnp.broadcast_to(
+                    jnp.sum(p, 1, keepdims=True), l_scr.shape[1:])
+                acc_scr[kk] = jax.lax.dot_general(
+                    p.astype(vs_ref.dtype), vs_ref[0, :, kk * d:(kk + 1) * d],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+
+        # ---- past blocks (previous put()s) streamed from the pool ---------
+        @pl.when(pos0 > 0)
+        def _past():
+            hi = jnp.minimum((pos0 - 1) // bs, nb_max - 1)
+            lo = jnp.int32(0)
+            if window is not None:
+                lo = jnp.maximum((pos0 - (window - 1)) // bs, 0)
+
+            def dma(i, buf):
+                bid = bt_ref[slot, jnp.clip(i, 0, nb_max - 1)]
+                return (pltpu.make_async_copy(kpool.at[bid], kbuf.at[buf],
+                                              dsem.at[buf, 0]),
+                        pltpu.make_async_copy(vpool.at[bid], vbuf.at[buf],
+                                              dsem.at[buf, 1]))
+
+            for c in dma(lo, 0):
+                c.start()
+
+            def body(i, _):
+                buf = jax.lax.rem(i - lo, 2)
+
+                @pl.when(i < hi)
+                def _prefetch():
+                    for c in dma(i + 1, 1 - buf):
+                        c.start()
+
+                for c in dma(i, buf):  # waits recover the in-flight copy
+                    c.wait()
+                row_pos = pos0 + jax.lax.broadcasted_iota(
+                    jnp.int32, (R, bs), 0) // rep
+                col_pos = i * bs + jax.lax.broadcasted_iota(
+                    jnp.int32, (R, bs), 1)
+                keep = (col_pos < pos0) &                     (jax.lax.broadcasted_iota(jnp.int32, (R, bs), 0) // rep
+                     < alen)
+                if window is not None:
+                    keep = keep & (col_pos > row_pos - window)
+                for kk in range(K):
+                    qk = q[:, kk].reshape(R, d)
+                    s = jax.lax.dot_general(
+                        qk, kbuf[buf, :, kk * d:(kk + 1) * d],
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale  # [R, bs]
+                    s = jnp.where(keep, s, NEG_INF)
+                    m_prev = m_scr[kk, :, :1]
+                    m_new = jnp.maximum(m_prev, jnp.max(s, 1, keepdims=True))
+                    p = jnp.exp(s - m_new)
+                    corr = jnp.exp(m_prev - m_new)
+                    l_scr[kk] = jnp.broadcast_to(
+                        l_scr[kk, :, :1] * corr
+                        + jnp.sum(p, 1, keepdims=True), l_scr.shape[1:])
+                    acc_scr[kk] = acc_scr[kk] * corr + jax.lax.dot_general(
+                        p.astype(vbuf.dtype),
+                        vbuf[buf, :, kk * d:(kk + 1) * d],
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+                return 0
+
+            jax.lax.fori_loop(lo, hi + 1, body, 0)
+
+        out = acc_scr[:] / jnp.maximum(l_scr[:, :, :1], 1e-30)  # [K, R, d]
+        out = (out.reshape(K, tq, rep, d)
+               .transpose(1, 0, 2, 3)
+               .reshape(tq, K * rep, d))
+        # rows past alen saw only NEG_INF scores (exp(-inf - -inf) = 1):
+        # zero them like the reference (they are padding, never gathered)
+        row_ok = jax.lax.broadcasted_iota(jnp.int32, (tq, 1, 1), 0) < alen
+        o_ref[:] = jnp.where(row_ok, out, 0).astype(o_ref.dtype)
+
+    @pl.when(alen <= 0)
+    def _pad_atom():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+
+def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
+                           k_pool: jax.Array, v_pool: jax.Array,
+                           block_tables: jax.Array, atom_slot: jax.Array,
+                           atom_pos0: jax.Array, atom_len: jax.Array,
+                           tq: int, window: Optional[int] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Attention over atoms of the packed token row.
+
+    ``q``/``k_self``/``v_self``: [N, H|K, d] with N = n_atoms*tq; atom ``a``
+    covers rows [a*tq, a*tq+atom_len[a]) — consecutive positions
+    ``atom_pos0[a]+i`` of sequence slot ``atom_slot[a]``. The atom's own KV
+    (``k_self``/``v_self``) is read from VMEM, so the pools only need tokens
+    of PREVIOUS put()s (positions < atom_pos0) — the current step's appends
+    happen after the fact, in one hoisted scatter. Each past KV block is
+    DMA'd once per atom in the raw (lane-folded) pool layout, double-
+    buffered against the score/softmax compute. Returns [N, H, d]."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    N, H, d = q.shape
+    bs, K = k_pool.shape[1], k_pool.shape[2]
+    rep = H // K
+    A = N // tq
+    nb_max = block_tables.shape[1]
+    # Mosaic wants 128-lane-aligned DMA chunks and reshapes; geometries off
+    # the serving sweet spot (small head_dim models, tiny test configs) take
+    # the dense-gather XLA path instead — numerically identical
+    if not interpret and (d % 128 or bs % 8):
+        return xla_ragged_attention(q, k_self, v_self, k_pool, v_pool,
+                                    block_tables, atom_slot, atom_pos0,
+                                    atom_len, tq, window=window)
+    kernel = functools.partial(
+        _ragged_kernel, scale=1.0 / math.sqrt(d), bs=bs, tq=tq, K=K, rep=rep,
+        nb_max=nb_max, window=window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(A,),
+        in_specs=[
+            pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
+            pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
+            pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, K * d), k_pool.dtype),
+            pltpu.VMEM((2, bs, K * d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((K, tq * rep, 128), jnp.float32),
+            pltpu.VMEM((K, tq * rep, 128), jnp.float32),
+            pltpu.VMEM((K, tq * rep, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, H, d), q.dtype),
+        interpret=interpret,
+    )(atom_slot.astype(jnp.int32), atom_pos0.astype(jnp.int32),
+      atom_len.astype(jnp.int32), block_tables.astype(jnp.int32),
+      q, k_self.reshape(A, tq, K * d).astype(k_pool.dtype),
+      v_self.reshape(A, tq, K * d).astype(v_pool.dtype),
+      k_pool.reshape(k_pool.shape[0], bs, K * d),
+      v_pool.reshape(v_pool.shape[0], bs, K * d))
+
+
+def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
+                              v_self: jax.Array, k_pool: jax.Array,
+                              v_pool: jax.Array, block_tables: jax.Array,
+                              atom_slot: jax.Array, atom_pos0: jax.Array,
+                              atom_len: jax.Array, tq: int,
+                              axis: str = "tp",
+                              window: Optional[int] = None) -> jax.Array:
+    """Tensor-parallel :func:`ragged_paged_attention`: heads embarrassingly
+    parallel, q sharded on H, the atom KV and pools on K under shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        return ragged_paged_attention(q, k_self, v_self, k_pool, v_pool,
+                                      block_tables, atom_slot, atom_pos0,
+                                      atom_len, tq, window=window)
+    tp = mesh.shape[axis]
+    H, K = q.shape[1], k_pool.shape[2]
+    assert H % tp == 0 and K % tp == 0, (
+        f"tp={tp} must divide num_heads={H} and num_kv_heads={K}")
+    return jax.shard_map(
+        functools.partial(ragged_paged_attention, tq=tq, window=window),
+        in_specs=(P(None, axis, None), P(None, axis, None),
+                  P(None, axis, None), P(None, None, axis, None),
+                  P(None, None, axis, None), P(None, None), P(None), P(None),
+                  P(None)),
+        out_specs=P(None, axis, None),
+        check_vma=False,
+    )(q, k_self, v_self, k_pool, v_pool, block_tables, atom_slot, atom_pos0,
+      atom_len)
+
+
+def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
+                     block_tables: jax.Array, tok_slot: jax.Array,
+                     tok_pos: jax.Array,
+                     valid: Optional[jax.Array] = None) -> jax.Array:
+    """Write per-token KV rows for ALL layers into the stacked pool with one
+    in-place scatter (free under buffer donation — the per-layer scatter
+    inside a scan copies the whole pool every layer instead).
+
+    ``pool``: [L, nb+1, bs, K, d]; ``new_rows``: [L, N, K, d]; metadata [N].
+    Invalid rows are dropped (out-of-bounds index + mode='drop')."""
+    L, nbp1, bs, K, d = pool.shape
+    N = new_rows.shape[1]
+    bt_rows = block_tables[tok_slot]                          # [N, nb_max]
+    logical = jnp.clip(tok_pos // bs, 0, bt_rows.shape[1] - 1)
+    phys = jnp.take_along_axis(bt_rows, logical[:, None], axis=1)[:, 0]
+    off = tok_pos % bs
+    li = jnp.arange(L, dtype=jnp.int32)[:, None]
+    idx = (li * nbp1 + phys[None, :]) * bs + off[None, :]     # [L, N]
+    if valid is not None:
+        # one-past-the-end is definitively out of bounds → mode='drop'
+        # discards the row (negative indices would WRAP, not drop)
+        idx = jnp.where(valid[None, :], idx, L * nbp1 * bs)
+    flat = pool.reshape(L * nbp1 * bs, K, d)
+    flat = flat.at[idx.reshape(-1)].set(
+        new_rows.reshape(L * N, K, d).astype(pool.dtype),
+        mode="drop", unique_indices=True)
+    return flat.reshape(pool.shape)
+
+
+def xla_ragged_attention(q, k_self, v_self, k_pool, v_pool, block_tables,
+                         atom_slot, atom_pos0, atom_len, tq, window=None):
+    """Dense-gather reference for :func:`ragged_paged_attention` (parity
+    tests; pools hold only PAST tokens, the atom's own KV comes from
+    ``k_self``/``v_self``)."""
+    N, H, d = q.shape
+    bs, K = k_pool.shape[1], k_pool.shape[2]
+    A = N // tq
+    S = block_tables.shape[1] * bs
+    rep = H // K
+    bt = block_tables[atom_slot]                              # [A, nb_max]
+    k_dense = k_pool[bt].reshape(A, S, K, d)
+    v_dense = v_pool[bt].reshape(A, S, K, d)
+    ks = k_self.reshape(A, tq, K, d)
+    vs = v_self.reshape(A, tq, K, d)
+    k_all = jnp.concatenate([k_dense, ks], axis=1)            # [A, S+tq, K, d]
+    v_all = jnp.concatenate([v_dense, vs], axis=1)
+    if K != H:
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    qa = q.reshape(A, tq, H, d)
+    s = jnp.einsum("athd,ashd->ahts", qa, k_all,
+                   preferred_element_type=jnp.float32) / math.sqrt(d)
+    row = (atom_pos0[:, None] + jnp.arange(tq)[None, :])[:, None, :, None]
+    colpos = jnp.concatenate(
+        [jnp.arange(S)[None, :] + jnp.zeros((A, 1), jnp.int32),
+         atom_pos0[:, None] + jnp.arange(tq)[None, :]],
+        axis=1)[:, None, None, :]                             # [A,1,1,S+tq]
+    is_past = (jnp.arange(S + tq) < S)[None, None, None, :]
+    keep = jnp.where(is_past, colpos < atom_pos0[:, None, None, None],
+                     colpos <= row)
+    keep = keep & (jnp.arange(tq)[None, None, :, None]
+                   < atom_len[:, None, None, None])
+    col_valid = jnp.where(
+        is_past, True,
+        (jnp.arange(S + tq) - S)[None, None, None, :]
+        < atom_len[:, None, None, None])
+    keep = keep & col_valid
+    if window is not None:
+        keep = keep & (colpos > row - window)
+    s = jnp.where(keep, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("ahts,ashd->athd", p, v_all)
+    out = jnp.where((jnp.arange(tq) < atom_len[:, None])[:, :, None, None],
+                    out, 0)
+    return out.reshape(N, H, d)
+
+
 def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                     block_tables: jax.Array, pos: jax.Array,
                     window: Optional[int] = None,

@@ -10,6 +10,18 @@ from deepspeed_tpu.inference import BlockedAllocator, InferenceEngine, Inference
 from deepspeed_tpu.models import TransformerLM, get_preset
 
 
+def jnp_f(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(x, np.float32))
+
+
+def jnp_np(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
 @pytest.fixture(scope="module")
 def tiny_lm():
     model = TransformerLM(get_preset("tiny"))
@@ -323,9 +335,120 @@ def test_packed_jit_cache_bounded(tiny_lm):
         eng.put([uid], [rng.integers(0, 256, n)])
     for uid in range(4):                           # 4 decodes → 8 bucket too
         eng.put([uid], [np.array([uid + 1])])
-    eng.put([0, 1], [rng.integers(0, 256, 9), np.array([2])])  # 10 → 16
-    # 2 buckets (8, 16) + 1: the first call's freshly-placed cache signs
-    # differently from the steady-state donated cache (one extra trace-cache
-    # entry, no extra XLA compile)
-    assert eng._step_packed._cache_size() <= 3, \
+    eng.put([0, 1], [rng.integers(0, 256, 9), np.array([2])])  # mixed step
+    # 3 layout buckets — (tile-only 32), (decode-only 8), (mixed 8+32) — + 1:
+    # the first call's freshly-placed cache signs differently from the
+    # steady-state donated cache (an extra trace-cache entry, no extra XLA
+    # compile)
+    assert eng._step_packed._cache_size() <= 4, \
         eng._step_packed._cache_size()
+
+
+def test_decode_batch_matches_sequential_puts(tiny_lm):
+    """The fused on-device decode loop (CUDA-graph-replay parity) must
+    produce exactly the tokens that per-step greedy put() calls produce."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(10)
+    p1 = rng.integers(0, 256, 6)
+    p2 = rng.integers(0, 256, 4)
+
+    def run_sequential():
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=32, block_size=8)
+        r = eng.put([1, 2], [p1, p2])
+        toks = {1: [], 2: []}
+        cur = {u: int(np.argmax(r[u])) for u in (1, 2)}
+        for _ in range(5):
+            r = eng.put([1, 2], [np.array([cur[1]]), np.array([cur[2]])])
+            for u in (1, 2):
+                cur[u] = int(np.argmax(r[u]))
+                toks[u].append(cur[u])
+        return toks
+
+    def run_fused():
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=32, block_size=8)
+        r = eng.put([1, 2], [p1, p2])
+        first = {u: int(np.argmax(r[u])) for u in (1, 2)}
+        out = eng.decode_batch([1, 2], [first[1], first[2]], steps=5)
+        return {u: list(out[u]) for u in (1, 2)}
+
+    seq_toks, fused_toks = run_sequential(), run_fused()
+    for u in (1, 2):
+        assert seq_toks[u] == fused_toks[u], (u, seq_toks[u], fused_toks[u])
+
+
+class TestRaggedKernels:
+    """Numeric parity of the atom-based serving kernels (reference
+    v2/kernels/ragged_ops/blocked_flash + atom_builder) against the dense
+    gather implementation."""
+
+    @staticmethod
+    def _pools(rng, nbp1=17, bs=8, K=2, d=16):
+        kp = jnp_f(rng.normal(size=(nbp1, bs, K, d)))
+        vp = jnp_f(rng.normal(size=(nbp1, bs, K, d)))
+        bt = np.asarray(rng.permutation(16)[:12].reshape(3, 4), np.int32)
+        return kp, vp, jnp_np(bt)
+
+    def test_chunk_atoms_match_reference(self):
+        from deepspeed_tpu.ops.paged_attention import (
+            ragged_paged_attention, xla_ragged_attention)
+
+        rng = np.random.default_rng(0)
+        kp, vp, bt = self._pools(rng)
+        tq, A, H, d = 4, 3, 4, 16
+        q = jnp_f(rng.normal(size=(A * tq, H, d)))
+        ks = jnp_f(rng.normal(size=(A * tq, 2, d)))
+        vs = jnp_f(rng.normal(size=(A * tq, 2, d)))
+        a_slot = jnp_np(np.array([0, 1, 0], np.int32))
+        a_pos0 = jnp_np(np.array([4, 9, 0], np.int32))
+        a_len = jnp_np(np.array([4, 1, 0], np.int32))   # incl. pad atom
+        for win in (None, 5):
+            got = np.asarray(ragged_paged_attention(
+                q, ks, vs, kp, vp, bt, a_slot, a_pos0, a_len, tq=tq,
+                window=win))
+            ref = np.asarray(xla_ragged_attention(
+                q, ks, vs, kp, vp, bt, a_slot, a_pos0, a_len, tq,
+                window=win))
+            np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+    def test_decode_atoms_match_reference(self):
+        from deepspeed_tpu.ops.paged_attention import (
+            ragged_paged_attention, xla_ragged_attention)
+
+        rng = np.random.default_rng(1)
+        kp, vp, bt = self._pools(rng)
+        q = jnp_f(rng.normal(size=(4, 4, 16)))
+        ks = jnp_f(rng.normal(size=(4, 2, 16)))
+        vs = jnp_f(rng.normal(size=(4, 2, 16)))
+        s1 = jnp_np(np.array([0, 1, 2, 0], np.int32))
+        p1 = jnp_np(np.array([8, 3, 0, 15], np.int32))  # incl. pos0=0
+        l1 = jnp_np(np.array([1, 1, 1, 0], np.int32))   # incl. pad row
+        got = np.asarray(ragged_paged_attention(q, ks, vs, kp, vp, bt,
+                                                s1, p1, l1, tq=1))
+        ref = np.asarray(xla_ragged_attention(q, ks, vs, kp, vp, bt,
+                                              s1, p1, l1, 1))
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+    def test_packed_kv_append_scatter(self):
+        from deepspeed_tpu.ops.paged_attention import packed_kv_append
+
+        rng = np.random.default_rng(2)
+        _, _, bt = self._pools(rng)
+        L, nbp1, bs, K, d = 2, 17, 8, 2, 16
+        pool = jnp_f(np.zeros((L, nbp1, bs, K, d)))
+        rows = jnp_f(rng.normal(size=(L, 5, K, d)))
+        ts = jnp_np(np.array([0, 0, 2, 1, 1], np.int32))
+        tp = jnp_np(np.array([10, 11, 3, 0, 1], np.int32))
+        va = jnp_np(np.array([1, 1, 1, 0, 0], bool))
+        out = np.asarray(packed_kv_append(pool, rows, bt, ts, tp, va))
+        btn = np.asarray(bt)
+        b, o = int(btn[0, 10 // bs]), 10 % bs
+        np.testing.assert_allclose(out[1, b, o], np.asarray(rows[1, 0]))
+        b, o = int(btn[2, 0]), 3
+        np.testing.assert_allclose(out[0, b, o], np.asarray(rows[0, 2]))
+        # invalid rows dropped: total mass == the three valid rows' mass
+        np.testing.assert_allclose(np.abs(out).sum(),
+                                   float(jnp_f(np.abs(
+                                       np.asarray(rows[:, :3]))).sum()),
+                                   rtol=1e-6)
