@@ -168,12 +168,15 @@ class ZenFlowConfig(DSTpuConfigModel):
                 "zenflow: overlap_step and the top-k selective split are "
                 "alternative overlap mechanisms — enable one, not both")
         if self.topk_ratio == 0 and not self.overlap_step:
-            logger.warning(
-                "zero_optimization.zenflow is enabled but both mechanisms are "
-                "off (overlap_step=False, topk_ratio=0) — the block is a "
-                "no-op; set overlap_step=true or topk_ratio>0. NOTE: "
-                "overlap_step's default changed from true to false to match "
-                "the reference default.")
+            # an all-default zenflow block is almost certainly a migrated
+            # config that relied on overlap_step's old true default — a
+            # silent no-op optimizer offload would be easy to miss in logs
+            raise ValueError(
+                "zero_optimization.zenflow is enabled but both mechanisms "
+                "are off (overlap_step=False, topk_ratio=0) — the block "
+                "would be a no-op. Set overlap_step=true or topk_ratio>0 "
+                "(overlap_step's default changed from true to false to "
+                "match the reference default).")
         return self
 
     def resolved_update_interval(self) -> int:

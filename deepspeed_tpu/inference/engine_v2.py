@@ -179,6 +179,11 @@ class InferenceEngineV2:
         for uid in batch_uids:
             if not self.state.can_schedule(uid, steps):
                 raise RuntimeError(f"cannot schedule uid={uid} (+{steps})")
+        if not self.state.can_schedule_batch(batch_uids,
+                                             [steps] * len(batch_uids)):
+            raise RuntimeError(
+                f"cannot schedule uids={list(batch_uids)} jointly "
+                "(aggregate KV demand exceeds the pool)")
         descs = [self.state.schedule(uid, steps) for uid in batch_uids]
         B = len(descs)
         bpad = max(8, 1 << (B - 1).bit_length())  # bounded jit cache as B drains
@@ -212,13 +217,16 @@ class InferenceEngineV2:
         if self.packed:
             # chunked prefill (FastGen scheduling behavior): prompts longer
             # than one atom are fed in MAX_ATOM slices over internal steps.
-            # Capacity is checked for the WHOLE prompt first — a mid-prompt
-            # failure would otherwise leave the sequence half-prefilled.
+            # JOINT capacity is checked for the WHOLE batch of prompts first
+            # — a mid-prompt failure would otherwise leave sequences
+            # half-prefilled with the pool partially consumed.
             cap = self.module.MAX_ATOM
-            for uid, c in zip(batch_uids, chunks):
-                if len(c) > cap and not self.state.can_schedule(uid, len(c)):
-                    raise RuntimeError(
-                        f"cannot schedule uid={uid} (+{len(c)} tokens)")
+            if any(len(c) > cap for c in chunks) and \
+                    not self.state.can_schedule_batch(
+                        batch_uids, [len(c) for c in chunks]):
+                raise RuntimeError(
+                    f"cannot schedule uids={list(batch_uids)} "
+                    f"(+{[len(c) for c in chunks]} tokens jointly)")
             while any(len(c) > cap for c in chunks):
                 sel = [(u, c[:cap]) for u, c in zip(batch_uids, chunks)
                        if len(c) > cap]
@@ -227,6 +235,11 @@ class InferenceEngineV2:
         for uid, toks in zip(batch_uids, chunks):
             if not self.state.can_schedule(uid, len(toks)):
                 raise RuntimeError(f"cannot schedule uid={uid} (+{len(toks)} tokens)")
+        if not self.state.can_schedule_batch(batch_uids,
+                                             [len(c) for c in chunks]):
+            raise RuntimeError(
+                f"cannot schedule uids={list(batch_uids)} jointly "
+                "(aggregate KV demand exceeds the pool)")
         descs = [self.state.schedule(uid, len(toks))
                  for uid, toks in zip(batch_uids, chunks)]
 

@@ -86,6 +86,25 @@ class SequenceManager:
         slots_ok = uid in self.sequences or bool(self._free_slots)
         return slots_ok and need_blocks <= self.allocator.free_blocks
 
+    def can_schedule_batch(self, uids, n_tokens) -> bool:
+        """Joint schedulability: per-uid checks can each pass while the
+        AGGREGATE block demand exceeds the pool — scheduling would then fail
+        midway with earlier uids' blocks already taken. Engines gate every
+        multi-sequence step on this."""
+        need = 0
+        new_slots = 0
+        for uid, n in zip(uids, n_tokens):
+            seq = self.sequences.get(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seen + n > self.max_seq_len:
+                return False
+            if seq is None:
+                new_slots += 1
+            need += max(0, -(-(seen + n) // self.allocator.block_size)
+                        - (len(seq.blocks) if seq else 0))
+        return (new_slots <= len(self._free_slots)
+                and need <= self.allocator.free_blocks)
+
     def schedule(self, uid: int, new_tokens: int) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
         needed = -(-(seq.seen_tokens + new_tokens) // self.allocator.block_size)

@@ -93,17 +93,15 @@ def config_from_hf(hf_cfg: Any, **overrides) -> TransformerConfig:
         if model_type in ("mistral", "qwen2", "phi3"):
             win = get("sliding_window")
             if model_type == "qwen2":
-                if not get("use_sliding_window", False):
+                # HF qwen2 windows only layers i >= max_window_layers (the
+                # FIRST max_window_layers layers attend fully); mwl >=
+                # num_layers therefore means NO layer is windowed
+                mwl = int(get("max_window_layers", 0) or 0)
+                if not get("use_sliding_window", False) \
+                        or mwl >= kw["num_layers"]:
                     win = None
-                elif get("max_window_layers", 0) < kw["num_layers"]:
-                    # HF qwen2 gives the first max_window_layers layers FULL
-                    # attention; our window is global — importing would be
-                    # silently wrong on the mixed-layer checkpoints
-                    raise ValueError(
-                        "qwen2 with use_sliding_window and max_window_layers "
-                        f"< num_hidden_layers ({get('max_window_layers')} < "
-                        f"{kw['num_layers']}) mixes windowed and full layers "
-                        "— not supported")
+                elif mwl > 0:
+                    kw["window_start_layer"] = mwl  # mixed-window checkpoint
             kw["sliding_window"] = win
         if model_type == "qwen2":
             kw["qkv_bias"] = True

@@ -58,6 +58,10 @@ class TransformerConfig:
     parallel_shared_norm: bool = False  # falcon-7b: one ln feeds both branches
     rope_pct: float = 1.0         # gpt-neox partial rotary (rotary_pct)
     sliding_window: Optional[int] = None  # mistral/qwen2 windowed attention
+    # first layer index the window applies to (HF qwen2 semantics: layers
+    # i >= max_window_layers are windowed, earlier layers attend fully);
+    # 0 = window on every layer
+    window_start_layer: int = 0
     # HF-style rope_scaling dict ({"rope_type": "llama3"|"linear", ...});
     # None = unscaled
     rope_scaling: Optional[Dict[str, Any]] = None
@@ -280,8 +284,8 @@ def attn_out_proj(attn: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Arr
 
 def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                     freqs: Optional[jax.Array],
-                    attn_fn: Callable, positions: Optional[jax.Array] = None,
-                    kv_cache: Optional[Dict[str, jax.Array]] = None) -> Any:
+                    attn_fn: Callable,
+                    positions: Optional[jax.Array] = None) -> jax.Array:
     B, T, D = x.shape
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     q, k, v = qkv_proj(x, w, cfg)
@@ -290,19 +294,6 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     if cfg.use_rope:
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-    if kv_cache is not None:
-        # decode path: append at cache_pos, attend over the full cache
-        pos = kv_cache["pos"]
-        ck = jax.lax.dynamic_update_slice(kv_cache["k"], k, (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(kv_cache["v"], v, (0, pos, 0, 0))
-        S = ck.shape[1]
-        sidx = jnp.arange(S)[None, :]
-        valid = sidx < pos + T
-        if cfg.sliding_window is not None:
-            valid = valid & (sidx >= pos + T - cfg.sliding_window)
-        out = decode_attention(q, ck, cv, valid=valid)
-        new_cache = {"k": ck, "v": cv, "pos": pos + T}
-        return attn_out_proj(out, w, cfg), new_cache
     if cfg.sliding_window is not None:
         # windowed families (mistral/qwen2): the flash kernel takes the
         # window natively (block-skipping); impls without window support
@@ -320,7 +311,7 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     else:
         out = attn_fn(q, k, v, causal=True)
     o = attn_out_proj(out, w, cfg)
-    return constrain(o, P(("dp", "fsdp"), "sp", None)), None
+    return constrain(o, P(("dp", "fsdp"), "sp", None))
 
 
 def _cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -329,16 +320,6 @@ def _cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     k, v = repeat_kv(k, v, q.shape[2])
     scores = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(q.shape[-1])
     scores = jnp.where(valid[:, None], scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
-
-
-def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                     valid: jax.Array) -> jax.Array:
-    """Attention over a (padded) KV cache; valid: [1|B, S] bool."""
-    k, v = repeat_kv(k, v, q.shape[2])
-    scores = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(q.shape[-1])
-    scores = jnp.where(valid[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
@@ -401,8 +382,8 @@ def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     dt = jnp.dtype(cfg.dtype)
     wc = jax.tree_util.tree_map(lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, w)
     hn1 = _norm(x, wc["ln1"], cfg.norm, cfg.norm_eps)
-    attn_out, _ = attention_block(hn1, wc["attn"], cfg, freqs, attn_fn,
-                                  positions=positions)
+    attn_out = attention_block(hn1, wc["attn"], cfg, freqs, attn_fn,
+                               positions=positions)
     if cfg.parallel_block:
         # falcon/gpt-neox: attn and mlp branch from the SAME residual input
         h = hn1 if cfg.parallel_shared_norm else _norm(x, wc["ln2"], cfg.norm,
@@ -562,6 +543,25 @@ class TransformerLM:
             params, input_ids, positions=positions, ltd_seed=ltd_seed,
             pld_theta=pld_theta))
 
+    def _window_segments(self):
+        """Contiguous layer runs sharing one static window setting:
+        ``[(lo, hi, cfg_segment)]``. HF qwen2 gives the first
+        ``max_window_layers`` layers FULL attention (``window_start_layer``
+        here); each segment scans with its own cfg so windowed layers keep
+        the block-skipping flash/paged kernels and full layers never pay a
+        window mask."""
+        cfg = self.cfg
+        ws = cfg.window_start_layer
+        if cfg.sliding_window is None or ws <= 0:
+            return [(0, cfg.num_layers, cfg)]
+        ws = min(ws, cfg.num_layers)
+        segs = [(0, ws, dataclasses.replace(cfg, sliding_window=None,
+                                            window_start_layer=0))]
+        if ws < cfg.num_layers:
+            segs.append((ws, cfg.num_layers,
+                         dataclasses.replace(cfg, window_start_layer=0)))
+        return segs
+
     def hidden_states(self, params: Params, input_ids: jax.Array,
                       positions: Optional[jax.Array] = None,
                       ltd_seed: Optional[jax.Array] = None,
@@ -588,9 +588,36 @@ class TransformerLM:
             lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
             params["layers"])
 
+        segs = self._window_segments()
         T = input_ids.shape[1]
         ltd_keep = self._ltd_keep
         ltd = ltd_keep is not None and ltd_keep < T
+        if len(segs) > 1:
+            if ltd or pld_theta is not None:
+                raise NotImplementedError(
+                    "mixed-window layers (window_start_layer > 0) cannot "
+                    "combine with random-LTD or progressive layer drop")
+            aux_total = jnp.zeros((), jnp.float32)
+            for lo, hi, cseg in segs:
+                def seg_body(carry, xs, _c=cseg):
+                    return transformer_block(carry, xs, _c, freqs, attn_fn,
+                                             self.moe_fn)
+
+                seg_body = _maybe_remat(seg_body, cfg.remat_policy)
+                seg_layers = jax.tree_util.tree_map(
+                    lambda p: p[lo:hi], layers)
+                if cfg.scan_layers:
+                    x, auxes = jax.lax.scan(seg_body, x, seg_layers)
+                    aux_total = aux_total + jnp.sum(auxes)
+                else:
+                    for i in range(hi - lo):
+                        xi = jax.tree_util.tree_map(lambda p: p[i], seg_layers)
+                        x, aux = seg_body(x, xi)
+                        aux_total = aux_total + aux
+            x = _norm(x, {k: v for k, v in params["final_norm"].items()},
+                      cfg.norm, cfg.norm_eps)
+            self._last_aux_loss = aux_total
+            return constrain(x, P(("dp", "fsdp"), "sp", None))
         if ltd or pld_theta is not None:
             # shared routing key for LTD/PLD: step seed (engine-provided,
             # fresh per step/epoch) folded with batch content (fresh per
@@ -745,30 +772,43 @@ class TransformerLM:
             x = x + params["embed"]["pos"][positions].astype(dt)
         freqs = self._freqs
 
-        def body(carry, xs):
-            layer_w, ck, cv = xs
-            wc = jax.tree_util.tree_map(
-                lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
-            new_kv = {}
+        def make_body(cseg):
+            def body(carry, xs):
+                layer_w, ck, cv = xs
+                wc = jax.tree_util.tree_map(
+                    lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+                    layer_w)
+                new_kv = {}
 
-            def attn_cache_fn(q, k, v):
-                # per-sequence scatter of the new kv at each slot's position
-                bidx = jnp.arange(B)[:, None] + jnp.zeros((1, t), jnp.int32)
-                nk = ck.at[bidx, positions].set(k.astype(ck.dtype))
-                nv = cv.at[bidx, positions].set(v.astype(cv.dtype))
-                new_kv["k"], new_kv["v"] = nk, nv
-                sidx = jnp.arange(S)[None, None, :]
-                valid = sidx <= positions[:, :, None]  # [B,t,S]
-                if cfg.sliding_window is not None:
-                    valid = valid & (sidx > positions[:, :, None]
-                                     - cfg.sliding_window)
-                return _cached_attention(q, nk, nv, valid)
+                def attn_cache_fn(q, k, v):
+                    # per-sequence scatter of the new kv at each position
+                    bidx = jnp.arange(B)[:, None] + jnp.zeros((1, t), jnp.int32)
+                    nk = ck.at[bidx, positions].set(k.astype(ck.dtype))
+                    nv = cv.at[bidx, positions].set(v.astype(cv.dtype))
+                    new_kv["k"], new_kv["v"] = nk, nv
+                    sidx = jnp.arange(S)[None, None, :]
+                    vmask = sidx <= positions[:, :, None]  # [B,t,S]
+                    if cseg.sliding_window is not None:
+                        vmask = vmask & (sidx > positions[:, :, None]
+                                         - cseg.sliding_window)
+                    return _cached_attention(q, nk, nv, vmask)
 
-            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
-                              self.moe_fn, moe_valid=valid)
-            return h, (new_kv["k"], new_kv["v"])
+                h = _decode_block(carry, wc, cseg, freqs, positions,
+                                  attn_cache_fn, self.moe_fn, moe_valid=valid)
+                return h, (new_kv["k"], new_kv["v"])
 
-        x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+            return body
+
+        nk_parts, nv_parts = [], []
+        for lo, hi, cseg in self._window_segments():
+            seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                             params["layers"]),
+                      cache["k"][lo:hi], cache["v"][lo:hi])
+            x, (nk, nv) = jax.lax.scan(make_body(cseg), x, seg_xs)
+            nk_parts.append(nk)
+            nv_parts.append(nv)
+        nk = nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts)
+        nv = nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts)
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x @ self._head(params).astype(dt)
         new_cache = {"k": nk, "v": nv, "pos": pos + t}
@@ -813,25 +853,37 @@ class TransformerLM:
             x = x + params["embed"]["pos"][safe_pos].astype(dt)
         freqs = self._freqs
 
-        def body(carry, xs):
-            layer_w, kp, vp = xs
-            wc = jax.tree_util.tree_map(
-                lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
-            new_kv = {}
+        def make_body(cseg):
+            def body(carry, xs):
+                layer_w, kp, vp = xs
+                wc = jax.tree_util.tree_map(
+                    lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+                    layer_w)
+                new_kv = {}
 
-            def attn_cache_fn(q, k, v):
-                nk = paged_update(kp, k, block_tables, pos, valid)
-                nv = paged_update(vp, v, block_tables, pos, valid)
-                new_kv["k"], new_kv["v"] = nk, nv
-                return paged_attention_tp(q, nk, nv, block_tables, pos,
-                                          window=cfg.sliding_window)
+                def attn_cache_fn(q, k, v):
+                    nk = paged_update(kp, k, block_tables, pos, valid)
+                    nv = paged_update(vp, v, block_tables, pos, valid)
+                    new_kv["k"], new_kv["v"] = nk, nv
+                    return paged_attention_tp(q, nk, nv, block_tables, pos,
+                                              window=cseg.sliding_window)
 
-            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
-                              self.moe_fn, moe_valid=valid)
-            return h, (new_kv["k"], new_kv["v"])
+                h = _decode_block(carry, wc, cseg, freqs, positions,
+                                  attn_cache_fn, self.moe_fn, moe_valid=valid)
+                return h, (new_kv["k"], new_kv["v"])
 
-        x, (nk, nv) = jax.lax.scan(body, x,
-                                   (params["layers"], cache["k"], cache["v"]))
+            return body
+
+        nk_parts, nv_parts = [], []
+        for lo, hi, cseg in self._window_segments():
+            seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                             params["layers"]),
+                      cache["k"][lo:hi], cache["v"][lo:hi])
+            x, (nk, nv) = jax.lax.scan(make_body(cseg), x, seg_xs)
+            nk_parts.append(nk)
+            nv_parts.append(nv)
+        nk = nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts)
+        nv = nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts)
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x @ self._head(params).astype(dt)
         return logits, {"k": nk, "v": nv}
@@ -897,35 +949,49 @@ class TransformerLM:
             a_len_t = valid[dr:].reshape(n_tiles, tile_tq).sum(
                 axis=1, dtype=jnp.int32)
 
-        def body(carry, xs):
-            layer_w, kp, vp = xs
-            wc = jax.tree_util.tree_map(
-                lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, layer_w)
-            new_kv = {}
+        def make_body(cseg):
+            def body(carry, xs):
+                layer_w, kp, vp = xs
+                wc = jax.tree_util.tree_map(
+                    lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+                    layer_w)
+                new_kv = {}
 
-            def attn_cache_fn(q, k, v):
-                q2, k2, v2 = q[:, 0], k[:, 0], v[:, 0]          # [N, H|K, d]
-                new_kv["k"], new_kv["v"] = k2, v2  # appended after the scan
-                parts = []
-                if dr:
-                    parts.append(ragged_paged_attention_tp(
-                        q2[:dr], k2[:dr], v2[:dr], kp, vp, block_tables,
-                        a_slot_d, a_pos_d, a_len_d, tq=1,
-                        window=cfg.sliding_window))
-                if n_tiles:
-                    parts.append(ragged_paged_attention_tp(
-                        q2[dr:], k2[dr:], v2[dr:], kp, vp, block_tables,
-                        a_slot_t, a_pos_t, a_len_t, tq=tile_tq,
-                        window=cfg.sliding_window))
-                out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-                return out[:, None]                             # [N, 1, H, d]
+                def attn_cache_fn(q, k, v):
+                    q2, k2, v2 = q[:, 0], k[:, 0], v[:, 0]      # [N, H|K, d]
+                    new_kv["k"], new_kv["v"] = k2, v2  # appended after scan
+                    parts = []
+                    if dr:
+                        parts.append(ragged_paged_attention_tp(
+                            q2[:dr], k2[:dr], v2[:dr], kp, vp, block_tables,
+                            a_slot_d, a_pos_d, a_len_d, tq=1,
+                            window=cseg.sliding_window))
+                    if n_tiles:
+                        parts.append(ragged_paged_attention_tp(
+                            q2[dr:], k2[dr:], v2[dr:], kp, vp, block_tables,
+                            a_slot_t, a_pos_t, a_len_t, tq=tile_tq,
+                            window=cseg.sliding_window))
+                    out = (parts[0] if len(parts) == 1
+                           else jnp.concatenate(parts))
+                    return out[:, None]                         # [N, 1, H, d]
 
-            h = _decode_block(carry, wc, cfg, freqs, positions, attn_cache_fn,
-                              self.moe_fn, moe_valid=valid[:, None])
-            return h, (new_kv["k"], new_kv["v"])
+                h = _decode_block(carry, wc, cseg, freqs, positions,
+                                  attn_cache_fn, self.moe_fn,
+                                  moe_valid=valid[:, None])
+                return h, (new_kv["k"], new_kv["v"])
 
-        x, (krows, vrows) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            return body
+
+        kr_parts, vr_parts = [], []
+        for lo, hi, cseg in self._window_segments():
+            seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                             params["layers"]),
+                      cache["k"][lo:hi], cache["v"][lo:hi])
+            x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_xs)
+            kr_parts.append(kr)
+            vr_parts.append(vr)
+        krows = kr_parts[0] if len(kr_parts) == 1 else jnp.concatenate(kr_parts)
+        vrows = vr_parts[0] if len(vr_parts) == 1 else jnp.concatenate(vr_parts)
         nk = packed_kv_append(cache["k"], krows, block_tables, tok_slot,
                               tok_pos, valid)
         nv = packed_kv_append(cache["v"], vrows, block_tables, tok_slot,

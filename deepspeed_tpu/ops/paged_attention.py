@@ -432,8 +432,8 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
             pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
             pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
             pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
         scratch_shapes=[

@@ -290,3 +290,63 @@ def test_family_config_mapping():
                         "hidden_size": 64, "num_hidden_layers": 2,
                         "num_attention_heads": 4, "ffn_dim": 128,
                         "do_layer_norm_before": False})
+
+
+def test_qwen2_mixed_window_import_parity(tmp_path):
+    """HF qwen2 windows only layers i >= max_window_layers (the first layers
+    attend fully). The import threads window_start_layer into segmented layer
+    scans; logits must match transformers on a T > window sequence through
+    the train path AND the serving engines (round-2 ADVICE: the old gate was
+    inverted and applied the window globally)."""
+    import torch
+    import transformers as tr
+
+    from deepspeed_tpu.inference import InferenceEngine, InferenceEngineV2
+    from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+    torch.manual_seed(0)
+    cfg = tr.Qwen2Config(vocab_size=128, hidden_size=64, intermediate_size=96,
+                         num_hidden_layers=4, num_attention_heads=4,
+                         num_key_value_heads=2, max_position_embeddings=64,
+                         use_sliding_window=True, sliding_window=8,
+                         max_window_layers=2, attn_implementation="eager")
+    hf = tr.Qwen2ForCausalLM(cfg).eval()
+    hf.save_pretrained(str(tmp_path))
+    model, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+    assert model.cfg.sliding_window == 8
+    assert model.cfg.window_start_layer == 2
+    ids = np.random.default_rng(4).integers(0, 128, (2, 16))  # T=16 > win=8
+    ours = np.asarray(jax.jit(model.logits)(params, ids))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+    # serving parity: greedy decode through v1 and one packed v2 step
+    e1 = InferenceEngine(model, config={"mesh": {}}, params=params)
+    out = np.asarray(e1.generate(ids[:1], max_new_tokens=4))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids[:1]), max_new_tokens=4,
+                          do_sample=False).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+    e2 = InferenceEngineV2(model, params=params, max_sequences=2,
+                           max_seq_len=32, block_size=8)
+    r = e2.put([1], [ids[0]])
+    np.testing.assert_allclose(
+        np.asarray(r[1], np.float32), np.asarray(ours[0, -1], np.float32),
+        atol=3e-2)
+
+
+def test_qwen2_window_gate_not_inverted():
+    """use_sliding_window with max_window_layers >= num_layers means NO layer
+    is windowed — the import must clear the window, not apply it globally."""
+    from deepspeed_tpu.models.hf import config_from_hf
+
+    base = {"model_type": "qwen2", "vocab_size": 128, "hidden_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "intermediate_size": 96,
+            "use_sliding_window": True, "sliding_window": 8}
+    assert config_from_hf({**base, "max_window_layers": 2}).sliding_window \
+        is None
+    allwin = config_from_hf({**base, "max_window_layers": 0})
+    assert allwin.sliding_window == 8 and allwin.window_start_layer == 0

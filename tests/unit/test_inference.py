@@ -452,3 +452,23 @@ class TestRaggedKernels:
                                    float(jnp_f(np.abs(
                                        np.asarray(rows[:, :3]))).sum()),
                                    rtol=1e-6)
+
+
+def test_joint_capacity_rejected_before_any_scheduling(tiny_lm):
+    """Per-uid capacity checks can each pass while the aggregate demand
+    exceeds the pool; the engine must reject the batch atomically instead
+    of failing mid-prompt with sequences half-prefilled (review finding)."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(11)
+    # pool fits ONE 64-token prompt (8 blocks) but not two
+    eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                            max_seq_len=600, block_size=8, num_blocks=10)
+    p = rng.integers(0, 256, 64)
+    with pytest.raises(RuntimeError, match="jointly"):
+        eng.put([1, 2], [p, p])
+    # nothing was scheduled or allocated
+    assert eng.state.allocator.free_blocks == 10
+    assert not eng.state.sequences
+    # a single prompt still fits
+    eng.put([1], [p])
+    assert eng.state.sequences[1].seen_tokens == 64
