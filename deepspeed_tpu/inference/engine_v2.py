@@ -176,14 +176,11 @@ class InferenceEngineV2:
         regardless of ``steps`` — the throughput serving mode."""
         if not (self.paged and self.packed):
             raise ValueError("decode_batch needs the packed paged engine")
-        for uid in batch_uids:
-            if not self.state.can_schedule(uid, steps):
-                raise RuntimeError(f"cannot schedule uid={uid} (+{steps})")
         if not self.state.can_schedule_batch(batch_uids,
                                              [steps] * len(batch_uids)):
             raise RuntimeError(
-                f"cannot schedule uids={list(batch_uids)} jointly "
-                "(aggregate KV demand exceeds the pool)")
+                f"cannot schedule uids={list(batch_uids)} (+{steps} each: "
+                "per-sequence limit or aggregate KV demand exceeded)")
         descs = [self.state.schedule(uid, steps) for uid in batch_uids]
         B = len(descs)
         bpad = max(8, 1 << (B - 1).bit_length())  # bounded jit cache as B drains
@@ -232,14 +229,12 @@ class InferenceEngineV2:
                        if len(c) > cap]
                 self.put([u for u, _ in sel], [c for _, c in sel])
                 chunks = [c[cap:] if len(c) > cap else c for c in chunks]
-        for uid, toks in zip(batch_uids, chunks):
-            if not self.state.can_schedule(uid, len(toks)):
-                raise RuntimeError(f"cannot schedule uid={uid} (+{len(toks)} tokens)")
         if not self.state.can_schedule_batch(batch_uids,
                                              [len(c) for c in chunks]):
             raise RuntimeError(
-                f"cannot schedule uids={list(batch_uids)} jointly "
-                "(aggregate KV demand exceeds the pool)")
+                f"cannot schedule uids={list(batch_uids)} "
+                f"(+{[len(c) for c in chunks]} tokens: per-sequence limit or "
+                "aggregate KV demand exceeded)")
         descs = [self.state.schedule(uid, len(toks))
                  for uid, toks in zip(batch_uids, chunks)]
 

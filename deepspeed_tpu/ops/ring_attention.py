@@ -31,8 +31,11 @@ def _chunk_scores(q, k, scale):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
-                   causal: bool = True) -> jax.Array:
-    """Exact attention over the full (ring-distributed) sequence."""
+                   causal: bool = True,
+                   window: Optional[int] = None) -> jax.Array:
+    """Exact attention over the full (ring-distributed) sequence. ``window``
+    masks keys more than window-1 positions behind each query (global
+    positions — chunks rotate with their ring source index)."""
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, d = q.shape
@@ -51,8 +54,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
         src = (idx - s) % n  # rank whose kv chunk we currently hold
         kv_pos = src * Tl + jnp.arange(Tl)
         scores = _chunk_scores(q, k_cur, scale)  # [B, H, Tl, Tl]
-        if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]
+        if causal or window is not None:
+            mask = (q_pos[:, None] >= kv_pos[None, :]) if causal else True
+            if window is not None:
+                mask = mask & (kv_pos[None, :] > q_pos[:, None] - window)
             scores = jnp.where(mask[None, None], scores, NEG_INF)
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -77,7 +82,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
 
 def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = True,
-                        segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                        segment_ids: Optional[jax.Array] = None,
+                        window: Optional[int] = None) -> jax.Array:
     """``attention_impl="ring"``: engine-selectable context parallelism.
 
     Self-enters a shard_map manual over ``sp`` (sequence dim sharded, batch and
@@ -102,10 +108,12 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 "attention_impl='ulysses' when composing sp with pp")
 
     out = sp_shard_map(
-        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=causal),
+        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=causal,
+                                       window=window),
         q, k, v)
     if out is not None:
         return out
     from deepspeed_tpu.models.transformer import get_attention_impl
 
-    return get_attention_impl("auto")(q, k, v, causal=causal)
+    kw = {} if window is None else {"window": window}
+    return get_attention_impl("auto")(q, k, v, causal=causal, **kw)

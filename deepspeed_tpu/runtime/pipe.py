@@ -48,6 +48,14 @@ class PipelineModule:
         if model.cfg.num_layers % num_stages != 0:
             raise ValueError(f"num_layers={model.cfg.num_layers} not divisible by "
                              f"pipeline stages={num_stages}")
+        if model.cfg.sliding_window is not None \
+                and model.cfg.window_start_layer > 0:
+            # every stage runs ONE compiled program with a dynamic stage id,
+            # so a per-layer-range static window cannot be expressed here —
+            # running anyway would window the full-attention head layers
+            raise NotImplementedError(
+                "mixed-window models (window_start_layer > 0, qwen2-style) "
+                "are not supported under pipeline parallelism")
         self.model = model
         self.cfg = model.cfg
         self.num_stages = num_stages

@@ -27,9 +27,12 @@ def seq_all_to_all(x: jax.Array, axis: str, scatter_dim: int, gather_dim: int
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp",
-                      attn_fn: Optional[Callable] = None, causal: bool = True
-                      ) -> jax.Array:
-    """Full-sequence attention with heads sharded over ``axis``."""
+                      attn_fn: Optional[Callable] = None, causal: bool = True,
+                      window: Optional[int] = None) -> jax.Array:
+    """Full-sequence attention with heads sharded over ``axis``. ``window``
+    reaches the inner kernel (each head shard holds the FULL sequence after
+    the a2a, so the flash kernel's block-skipping window applies directly —
+    windowed long-context models keep O(T*W) attention under SP)."""
     if attn_fn is None:
         from deepspeed_tpu.models.transformer import get_attention_impl
 
@@ -38,7 +41,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str = "sp"
     q_full = seq_all_to_all(q, axis, 2, 1)
     k_full = seq_all_to_all(k, axis, 2, 1)
     v_full = seq_all_to_all(v, axis, 2, 1)
-    out = attn_fn(q_full, k_full, v_full, causal=causal)
+    kw = {} if window is None else {"window": window}
+    out = attn_fn(q_full, k_full, v_full, causal=causal, **kw)
     # scatter sequence back, gather heads
     return seq_all_to_all(out, axis, 1, 2)
 
@@ -54,9 +58,11 @@ class DistributedAttention:
         self.scatter_idx = scatter_idx
         self.gather_idx = gather_idx
 
-    def __call__(self, query, key, value, *args, causal: bool = True, **kwargs):
+    def __call__(self, query, key, value, *args, causal: bool = True,
+                 window: Optional[int] = None, **kwargs):
         return ulysses_attention(query, key, value, axis=self.axis,
-                                 attn_fn=self.local_attn, causal=causal)
+                                 attn_fn=self.local_attn, causal=causal,
+                                 window=window)
 
 
 # ---------------------------------------------------------------------------
@@ -104,7 +110,8 @@ def sp_shard_map(inner: Callable, q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            causal: bool = True,
-                           segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                           segment_ids: Optional[jax.Array] = None,
+                           window: Optional[int] = None) -> jax.Array:
     """``attention_impl="ulysses"``: the engine-selectable Ulysses path.
 
     Heads (and kv heads) must be divisible by the sp axis — same constraint as
@@ -130,10 +137,12 @@ def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 f"({k.shape[2]}) (per tp shard) divisible by sp={sp}; use "
                 f"attention_impl='ring' for the GQA/few-heads regime")
     out = sp_shard_map(
-        lambda a, b, c: ulysses_attention(a, b, c, axis="sp", causal=causal),
+        lambda a, b, c: ulysses_attention(a, b, c, axis="sp", causal=causal,
+                                          window=window),
         q, k, v)
     if out is not None:
         return out
     from deepspeed_tpu.models.transformer import get_attention_impl
 
-    return get_attention_impl("auto")(q, k, v, causal=causal)
+    kw = {} if window is None else {"window": window}
+    return get_attention_impl("auto")(q, k, v, causal=causal, **kw)

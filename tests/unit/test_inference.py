@@ -464,7 +464,7 @@ def test_joint_capacity_rejected_before_any_scheduling(tiny_lm):
     eng = InferenceEngineV2(model, params=params, max_sequences=4,
                             max_seq_len=600, block_size=8, num_blocks=10)
     p = rng.integers(0, 256, 64)
-    with pytest.raises(RuntimeError, match="jointly"):
+    with pytest.raises(RuntimeError, match="cannot schedule"):
         eng.put([1, 2], [p, p])
     # nothing was scheduled or allocated
     assert eng.state.allocator.free_blocks == 10

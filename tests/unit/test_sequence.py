@@ -259,3 +259,71 @@ def test_loss_tiling_matches_dense():
                     jax.tree_util.tree_leaves(g2)):
         # bf16 head matmul: chunked vs one-shot accumulation order differs
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+class TestWindowedSP:
+    """Sliding-window attention under sequence parallelism (round-2 weak #4:
+    windowed models used to silently fall back to dense masked attention
+    under sp — exactly the long-context regime where the window matters)."""
+
+    def test_ulysses_window_matches_dense(self, sp_mesh):
+        q, k, v = _qkv()
+        out = _run_sp(
+            sp_mesh,
+            lambda q, k, v: ulysses_attention(q, k, v, axis="sp", window=16),
+            q, k, v)
+        ref = xla_attention(q, k, v, causal=True, window=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_ring_window_matches_dense(self, sp_mesh):
+        q, k, v = _qkv()
+        out = _run_sp(
+            sp_mesh,
+            lambda q, k, v: ring_attention(q, k, v, axis="sp", window=16),
+            q, k, v)
+        ref = xla_attention(q, k, v, causal=True, window=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_attention_block_passes_window_to_sp_impls(self):
+        """The dispatch no longer demotes SP impls to the dense-mask path
+        for windowed models: ulysses/ring accept the window natively."""
+        import inspect
+
+        from deepspeed_tpu.ops.ring_attention import ring_attention_spmd
+        from deepspeed_tpu.sequence.layer import ulysses_attention_spmd
+
+        for fn in (ulysses_attention_spmd, ring_attention_spmd):
+            assert "window" in inspect.signature(fn).parameters
+
+    @pytest.mark.parametrize("impl", ["ulysses", "ring"])
+    def test_windowed_model_sp_loss_parity(self, impl, eight_devices):
+        """Mistral-style (windowed) model under sp=4: loss must match the
+        single-replica dense run — through the engine, windowed kernel
+        engaged."""
+        import dataclasses
+
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import TransformerLM, get_preset
+
+        cfg = dataclasses.replace(get_preset("tiny"), sliding_window=8,
+                                  attention_impl=impl, max_seq_len=64)
+        model = TransformerLM(cfg)
+        base = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 100,
+        }
+        b = {"input_ids": np.random.default_rng(0).integers(0, 256, (2, 64))}
+        eng_sp, *_ = ds.initialize(model=model, config={
+            **base, "mesh": {"sp": 4, "dp": 2}})
+        loss_sp = float(eng_sp.forward(b))
+        # reference: same mesh and data, dense masked attention
+        cfg_x = dataclasses.replace(cfg, attention_impl="xla")
+        eng_1, *_ = ds.initialize(model=TransformerLM(cfg_x), config={
+            **base, "mesh": {"sp": 4, "dp": 2}})
+        # same init seed → same params; same batch → same loss
+        loss_1 = float(eng_1.forward(b))
+        assert abs(loss_sp - loss_1) < 3e-2, (loss_sp, loss_1)
