@@ -327,7 +327,9 @@ class PipelineConfig(DSTpuConfigModel):
     partition_method: str = "parameters"  # parameters|uniform|type:regex
     micro_batches: Union[int, Literal["auto"]] = AUTO
     activation_checkpoint_interval: int = 0
-    pipe_schedule: str = "1f1b"  # 1f1b|gpipe
+    # auto = 1f1b, falling back to gpipe for ZeRO stage >= 2 (1f1b keeps the
+    # reference's stage <= 1 restriction; gpipe composes with ZeRO-3)
+    pipe_schedule: str = "auto"  # auto|1f1b|gpipe
 
 
 class CurriculumLearningConfig(DSTpuConfigModel):

@@ -337,6 +337,11 @@ class DeepSpeedTpuEngine:
             return loss * scale, loss
 
         def fwd_bwd(params, batch, scale):
+            if hasattr(model, "loss_and_grad"):
+                # hand-scheduled backward (1F1B pipeline): the model computes
+                # grads itself — autodiff of its loss_fn would reimpose the
+                # GPipe all-forwards-then-all-backwards order
+                return model.loss_and_grad(params, batch, scale)
             (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
                 params, batch, scale)
             return loss, grads

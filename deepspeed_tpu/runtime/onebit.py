@@ -79,9 +79,13 @@ def ga_grads(model, params, batch, scale, ga: int):
     sourced."""
 
     def micro(acc, mb):
-        loss, g = jax.value_and_grad(
-            lambda p: model.loss_fn(p, mb) * scale)(params)
-        return jax.tree_util.tree_map(jnp.add, acc, g), loss / scale
+        if hasattr(model, "loss_and_grad"):  # 1F1B pipeline: manual backward
+            loss, g = model.loss_and_grad(params, mb, scale)
+        else:
+            sloss, g = jax.value_and_grad(
+                lambda p: model.loss_fn(p, mb) * scale)(params)
+            loss = sloss / scale
+        return jax.tree_util.tree_map(jnp.add, acc, g), loss
 
     zeros = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)

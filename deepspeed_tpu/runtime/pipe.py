@@ -44,7 +44,8 @@ class PipelineModule:
 
     def __init__(self, model: TransformerLM, num_stages: int,
                  micro_batches: Optional[int] = None,
-                 activation_checkpointing: bool = True):
+                 activation_checkpointing: bool = True,
+                 schedule: str = "1f1b"):
         if model.cfg.num_layers % num_stages != 0:
             raise ValueError(f"num_layers={model.cfg.num_layers} not divisible by "
                              f"pipeline stages={num_stages}")
@@ -56,11 +57,22 @@ class PipelineModule:
             raise NotImplementedError(
                 "mixed-window models (window_start_layer > 0, qwen2-style) "
                 "are not supported under pipeline parallelism")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipe schedule '{schedule}'")
         self.model = model
         self.cfg = model.cfg
         self.num_stages = num_stages
         self.micro_batches = micro_batches or num_stages
         self.remat = activation_checkpointing
+        self.schedule = schedule
+        if schedule == "1f1b":
+            # the engine differentiates loss_fn; a hand-scheduled 1F1B
+            # interleaves fwd/bwd itself, so it exposes loss_and_grad and
+            # the engine uses it instead of jax.value_and_grad. Its backward
+            # recomputes each stage forward from the saved stage input by
+            # construction, so activation_checkpointing has no effect here
+            # (it tunes the GPipe autodiff path only).
+            self.loss_and_grad = self._loss_and_grad_1f1b
 
     def init(self, rng):
         return self.model.init(rng)
@@ -184,6 +196,225 @@ class PipelineModule:
         return lax.psum(jnp.where(idx == n - 1, loss, 0.0), "pp")
 
 
+    # ------------------------------------------------------------------
+    # 1F1B: hand-scheduled interleaved forward/backward
+    # (reference TrainSchedule, runtime/pipe/schedule.py:189)
+    # ------------------------------------------------------------------
+    def _loss_and_grad_1f1b(self, params, batch, scale=1.0):
+        """(unscaled mean loss, grads of scale*loss) by the 1F1B schedule.
+
+        Unlike the GPipe path (autodiff of the unrolled forward loop, which
+        runs ALL M microbatch forwards before any backward and stacks every
+        stage output), each microbatch's backward starts as soon as its loss
+        exists: per-stage live state is a rolling buffer of at most ``2*pp-1``
+        stage inputs — flat in M — the final norm + logits + loss run
+        per-MICROBATCH (a [mb, T, V] buffer instead of [B, T, V]; the head
+        computation itself stays replicated over pp like the GPipe path —
+        every stage runs one uniform program, and gating it with lax.cond
+        would trap the loss head's auto-partitioned collectives in a branch
+        only the last pp group takes), and the embedding gather's gradient
+        is owned by stage 0. Tied embedding/head
+        gradients meet in the end-of-schedule psum over ``pp``
+        (``ReduceTiedGrads`` parity, pipe/engine.py:274). Loss is the mean of
+        per-microbatch means — the reference's ``_scale_loss_by_gas``
+        semantics."""
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty or "pp" not in mesh.axis_names:
+            raise RuntimeError("PipelineModule loss requires a mesh context "
+                               "with a 'pp' axis (run under the engine)")
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(), params, is_leaf=lambda x: x is None)
+        param_specs["layers"] = jax.tree_util.tree_map(
+            lambda _: P("pp"), params["layers"])
+        batch_specs = jax.tree_util.tree_map(lambda _: P(), batch)
+        grad_specs = jax.tree_util.tree_map(
+            lambda _: P(), params, is_leaf=lambda x: x is None)
+        grad_specs["layers"] = jax.tree_util.tree_map(
+            lambda _: P("pp"), params["layers"])
+        # replicate the (tiny, int) token arrays BEFORE entering the manual
+        # region: the schedule indexes microbatches with a device-varying
+        # stage offset, and GSPMD check-fails both on that gather over a
+        # batch-sharded operand and on the reshard-to-replicated if done
+        # inside the region
+        batch = jax.tree_util.tree_map(
+            lambda v: jax.lax.with_sharding_constraint(
+                v, P(*(None,) * v.ndim)), batch)
+        # likewise gather ZeRO-3's fsdp shards of the NON-layer params (embed
+        # table, final norm, head) before entry — the stage-varying embedding
+        # gather over an fsdp-sharded table is the same GSPMD failure class.
+        # This is ZeRO-3's own gather-for-compute, done once per step; the
+        # per-stage LAYER shards stay sharded (pp manual + fsdp auto).
+        params = dict(params)
+        for k in params:
+            if k != "layers":
+                params[k] = jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v, P(*(None,) * v.ndim)), params[k])
+        fn = jax.shard_map(partial(self._local_1f1b, scale=scale), mesh=mesh,
+                           in_specs=(param_specs, batch_specs),
+                           out_specs=(P(), grad_specs), axis_names={"pp"},
+                           check_vma=False)
+        return fn(params, batch)
+
+    def _local_1f1b(self, params, batch, *, scale):
+        cfg = self.cfg
+        if (jnp.dtype(cfg.dtype) == jnp.bfloat16
+                and jax.default_backend() == "cpu"):
+            cfg = dataclasses.replace(cfg, dtype="float32")  # see _local_loss
+        n = lax.axis_size("pp")
+        idx = lax.axis_index("pp")
+        M = self.micro_batches
+        dt = jnp.dtype(cfg.dtype)
+        attn_fn = get_attention_impl(cfg.attention_impl)
+        freqs = self.model._freqs
+
+        U = P.UNCONSTRAINED
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        if B % M != 0:
+            raise ValueError(
+                f"pipeline micro_batches={M} must divide the global batch {B}")
+        mb = B // M
+        batch_mb = {k: v.reshape((M, mb) + v.shape[1:])
+                    for k, v in batch.items()}
+        rest = {k: v for k, v in params.items() if k != "layers"}
+
+        def stage_fwd(layers_local, h):
+            def body(carry, layer_w):
+                y, _aux = transformer_block(carry, layer_w, cfg, freqs,
+                                            attn_fn)
+                return y, None
+
+            h, _ = lax.scan(body, h, layers_local)
+            return h
+
+        def select_mb(tree, m):
+            # one-hot select of microbatch m (device-varying across stages):
+            # a varying-offset dynamic-slice trips GSPMD's group math when
+            # other dims carry auto sharding
+            def one(v):
+                sel = jnp.arange(M) == m
+                shaped = sel.reshape((M,) + (1,) * (v.ndim - 1))
+                return jnp.sum(jnp.where(shaped, v, 0), axis=0, dtype=v.dtype)
+
+            return jax.tree_util.tree_map(one, tree)
+
+        def embed_mb(rest_p, m):
+            idsm = select_mb(ids_mb, m)
+            x = rest_p["embed"]["tokens"].astype(dt)[idsm]
+            if cfg.learned_pos:
+                x = x + rest_p["embed"]["pos"][:T].astype(dt)
+            return x
+
+        ids_mb = ids.reshape(M, mb, T)
+
+        def tick_fwd(layers_p, rest_p, h_recv, m):
+            # stage 0 embeds its microbatch; others consume the received
+            # activation. The where routes the backward cotangent to the
+            # embedding only on stage 0.
+            x_m = embed_mb(rest_p, m)
+            h_in = jnp.where(idx == 0, x_m, h_recv)
+            return stage_fwd(layers_p, h_in)
+
+        def head_loss(rest_p, h, m):
+            # same partitioner limitation as _local_loss: the tp-sharded head
+            # matmul on sp-sharded activations check-fails inside the pp
+            # region — pin the sequence dim unsharded for the loss head
+            h = lax.with_sharding_constraint(h, P(U, None, None))
+            h = _norm(h, rest_p["final_norm"], cfg.norm, cfg.norm_eps)
+            head = (rest_p["embed"]["tokens"].T if cfg.tie_embeddings
+                    else rest_p["lm_head"])
+            logits = h @ head.astype(dt)
+            # the vocab dim must leave the loss tp-UNSHARDED: cross-entropy's
+            # take_along_axis/logsumexp over a tp-sharded vocab dim inside
+            # the pp manual region check-fails in GSPMD's group math
+            logits = lax.with_sharding_constraint(logits, P(U, None, None))
+            bm = select_mb(batch_mb, m)
+            return lm_loss(cfg, logits, bm)
+
+        BUF = 2 * n  # rolling stage-input buffer: in-flight <= 2(pp-1)+1
+        bufs = jnp.zeros((BUF + 1, mb, T, cfg.hidden_size), dt)
+        fwd_state = jnp.zeros((mb, T, cfg.hidden_size), dt)
+        cot_state = jnp.zeros((mb, T, cfg.hidden_size), jnp.float32)
+        g_layers = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params["layers"])
+        g_rest = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), rest)
+        loss_sum = jnp.zeros((), jnp.float32)
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+        def bwd(layers_p, rest_p, h_recv, m, cot):
+            """One uniform backward program for every stage (branching with
+            lax.cond would put the loss head's auto-partitioned collectives
+            inside a branch only the last pp group takes, deadlocking the
+            mesh; a vdot-objective formulation trips a GSPMD group-math check
+            under pp x dp x tp). The last stage seeds its cotangent from the
+            per-microbatch loss; others use the one received from downstream
+            — the head's gradient contributions are where-masked off
+            elsewhere. The head matmul itself stays replicated over pp, as in
+            the GPipe path (a known cost of the SPMD pipeline)."""
+            out, vjp_stage = jax.vjp(
+                lambda lp, rp, h: tick_fwd(lp, rp, h, m),
+                layers_p, rest_p, h_recv)
+            lossm, (g_rest_head, g_out) = jax.value_and_grad(
+                lambda rp, o: head_loss(rp, o, m), argnums=(0, 1))(rest_p, out)
+            is_last = (idx == n - 1).astype(jnp.float32)
+            cot_eff = jnp.where(idx == n - 1,
+                                g_out.astype(jnp.float32) * (scale / M), cot)
+            gl, gr, gh = vjp_stage(cot_eff.astype(out.dtype))
+            gr = jax.tree_util.tree_map(
+                lambda a, b: a.astype(jnp.float32)
+                + is_last * (scale / M) * b.astype(jnp.float32),
+                gr, g_rest_head)
+            return (None, lossm), (gl, gr, gh)
+
+        # static tick loop: fwd wave front-to-back, each microbatch's backward
+        # launching the tick its loss exists (last stage: same tick as its
+        # forward) and ppermuting back one stage per tick
+        for j in range(M + 2 * (n - 1)):
+            # ---- forward half-tick ----
+            m_f = j - idx
+            f_valid = jnp.logical_and(m_f >= 0, m_f < M)
+            m_fc = jnp.clip(m_f, 0, M - 1)
+            out = tick_fwd(params["layers"], rest, fwd_state, m_fc)
+            slot = jnp.where(f_valid, m_fc % BUF, BUF)  # BUF = trash slot
+            # one-hot select instead of a device-varying dynamic-update:
+            # GSPMD check-fails on varying-offset scatters over operands that
+            # are simultaneously auto-sharded on other dims
+            sel = (jnp.arange(BUF + 1) == slot)[:, None, None, None]
+            bufs = jnp.where(sel, fwd_state[None], bufs)
+            fwd_next = lax.ppermute(
+                jnp.where(f_valid, out, 0), "pp", perm_f)
+            # ---- backward half-tick ----
+            m_b = j - 2 * (n - 1) + idx
+            b_valid = jnp.logical_and(m_b >= 0, m_b < M)
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            rsel = (jnp.arange(BUF + 1) == m_bc % BUF)[:, None, None, None]
+            h_saved = jnp.sum(jnp.where(rsel, bufs, 0), axis=0,
+                              dtype=bufs.dtype)
+            (_, lossm), (gl, gr, gh) = bwd(params["layers"], rest, h_saved,
+                                           m_bc, cot_state)
+            bm = b_valid.astype(jnp.float32)
+            g_layers = jax.tree_util.tree_map(
+                lambda a, g: a + bm * g.astype(jnp.float32), g_layers, gl)
+            g_rest = jax.tree_util.tree_map(
+                lambda a, g: a + bm * g.astype(jnp.float32), g_rest, gr)
+            loss_sum = loss_sum + jnp.where(
+                jnp.logical_and(b_valid, idx == n - 1), lossm, 0.0)
+            cot_state = lax.ppermute(
+                jnp.where(b_valid, gh.astype(jnp.float32), 0), "pp", perm_b)
+            fwd_state = fwd_next
+
+        # tied/replicated-param gradients meet across stages here
+        # (ReduceTiedGrads parity); per-stage layer grads stay local
+        g_rest = jax.tree_util.tree_map(lambda g: lax.psum(g, "pp"), g_rest)
+        loss = lax.psum(loss_sum, "pp") / M
+        grads = dict(g_rest)
+        grads["layers"] = g_layers
+        return loss, grads
+
+
 def maybe_wrap_pipeline(model, config, topology):
     """Auto-wrap for ``initialize()`` when the mesh has pp > 1."""
     pp = topology.axis_sizes.get("pp", 1)
@@ -194,4 +425,19 @@ def maybe_wrap_pipeline(model, config, topology):
                          "your model in PipelineModule yourself)")
     micro = config.pipeline.micro_batches
     micro = None if micro in (None, "auto") else int(micro)
-    return PipelineModule(model, pp, micro_batches=micro)
+    schedule = config.pipeline.pipe_schedule
+    # 1F1B does not compose with ZeRO stage >= 2 (same restriction as the
+    # reference PipelineEngine): the hand-scheduled backward's per-tick vjp
+    # over fsdp-sharded weights trips GSPMD's group math. The GPipe path
+    # composes with ZeRO-3 (beyond reference).
+    if config.zero_optimization.stage >= 2:
+        if schedule == "1f1b":
+            raise ValueError(
+                "pipeline.pipe_schedule='1f1b' does not compose with ZeRO "
+                "stage >= 2; use pipe_schedule='gpipe' (which supports "
+                "ZeRO-3) or ZeRO stage <= 1")
+        if schedule == "auto":
+            schedule = "gpipe"
+    elif schedule == "auto":
+        schedule = "1f1b"
+    return PipelineModule(model, pp, micro_batches=micro, schedule=schedule)
