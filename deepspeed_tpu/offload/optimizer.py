@@ -52,6 +52,17 @@ def _host_copy(leaf) -> np.ndarray:
     return np.ascontiguousarray(arr)
 
 
+def _index_key(index, shape) -> tuple:
+    """Hashable, sortable key for a shard's global-array index (slice tuple);
+    open-ended slices (replicated dims) normalize to the full extent."""
+    return tuple((s.start or 0, s.stop if s.stop is not None else d)
+                 for s, d in zip(index, shape))
+
+
+def _key_slices(key) -> tuple:
+    return tuple(slice(a, b) for a, b in key)
+
+
 def _leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out = []
@@ -66,7 +77,8 @@ class HostOffloadOptimizer:
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  gradient_clipping: float = 0.0, schedule_fn=None,
                  nvme_path: Optional[str] = None, aio_threads: int = 2,
-                 overlap_step: bool = False):
+                 overlap_step: bool = False, shard_host_tier: bool = True,
+                 state_shardings: Any = None):
         self.adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
                                      weight_decay=weight_decay)
         self.schedule_fn = schedule_fn
@@ -79,24 +91,84 @@ class HostOffloadOptimizer:
         self.swapper = (AsyncTensorSwapper(os.path.join(nvme_path, "opt_states"),
                                            num_threads=aio_threads)
                         if nvme_path else None)
-        # fp32 master copies on host
-        self.master: Dict[str, np.ndarray] = {}
+        # SHARDED host tier (reference stage_1_and_2 cpu_offload partitioning):
+        # the fp32 masters/moments are stored per UNIQUE param shard — one
+        # buffer per distinct shard index, replicas deduplicated — so on a
+        # multi-host pod each process keeps and transfers only its own
+        # addressable 1/fsdp of the model instead of the whole tree.
+        # self._layout[name] = [(index_key, [devices])] in a stable order;
+        # shard key "name#i" addresses buffer i of the leaf.
+        self._layout: Dict[str, list] = {}
+        self._shapes: Dict[str, tuple] = {}
+        self.master: Dict[str, np.ndarray] = {}   # keyed "name#i"
         self.m: Dict[str, np.ndarray] = {}
         self.v: Dict[str, np.ndarray] = {}
+        self._sharded_tier = shard_host_tier
+        self._state_sh: Dict[str, Any] = {}
+        state_map = (dict(_leaf_paths(state_shardings))
+                     if state_shardings is not None else {})
         for name, leaf in _leaf_paths(params):
-            self.master[name] = _host_copy(leaf)
-            m = np.zeros_like(self.master[name])
-            v = np.zeros_like(self.master[name])
-            if self.swapper is not None:
-                self.swapper.swap_out(name + ".m", m)
-                self.swapper.swap_out(name + ".v", v)
-            else:
-                self.m[name], self.v[name] = m, v
+            self._shapes[name] = tuple(leaf.shape)
+            target_sh = state_map.get(name) if shard_host_tier else None
+            if target_sh is not None:
+                # partition the host tier by the OPTIMIZER-STATE sharding
+                # (ZeRO-1/2 keep params replicated while the opt states shard
+                # over fsdp — stage_1_and_2 cpu_offload partitioning): one
+                # buffer per distinct state-shard index.
+                self._state_sh[name] = target_sh
+                idx_map = target_sh.addressable_devices_indices_map(
+                    tuple(leaf.shape))
+                groups: Dict[tuple, list] = {}
+                for dev, index in idx_map.items():
+                    groups.setdefault(_index_key(index, leaf.shape),
+                                      []).append(dev)
+                self._layout[name] = sorted(groups.items())
+                full_key = tuple((0, s) for s in leaf.shape)
+                full = None
+                for i, (key, _devs) in enumerate(self._layout[name]):
+                    skey = f"{name}#{i}"
+                    if key == full_key:
+                        master = _host_copy(leaf)
+                    else:
+                        if full is None:
+                            # _host_copy: a raw device_get may ALIAS the live
+                            # param buffer on the CPU backend — the in-place
+                            # host Adam would then mutate the model mid-step
+                            full = _host_copy(leaf)
+                        master = np.ascontiguousarray(full[_key_slices(key)])
+                    self._init_shard(skey, master)
+                continue
+            if not shard_host_tier:  # one full buffer per leaf (legacy form)
+                full_key = tuple((0, s) for s in leaf.shape)
+                self._layout[name] = [(full_key, None)]
+                self._init_shard(f"{name}#0", _host_copy(leaf))
+                continue
+            groups: Dict[tuple, list] = {}
+            datas: Dict[tuple, Any] = {}
+            for sh in leaf.addressable_shards:
+                key = _index_key(sh.index, leaf.shape)
+                groups.setdefault(key, []).append(sh.device)
+                datas.setdefault(key, sh.data)
+            self._layout[name] = sorted(groups.items())
+            for i, (key, _devs) in enumerate(self._layout[name]):
+                self._init_shard(f"{name}#{i}", _host_copy(datas[key]))
         if self.swapper is not None:
             self.swapper.wait()
         total = sum(a.size for a in self.master.values())
+        n_shards = len(self.master)
         log_dist(f"host offload optimizer: {total/1e6:.1f}M fp32 master params "
+                 f"in {n_shards} shards "
                  f"({'nvme' if self.swapper else 'cpu'} moments)")
+
+    def _init_shard(self, skey: str, master: np.ndarray) -> None:
+        self.master[skey] = master
+        m = np.zeros_like(master)
+        v = np.zeros_like(master)
+        if self.swapper is not None:
+            self.swapper.swap_out(skey + ".m", m)
+            self.swapper.swap_out(skey + ".v", v)
+        else:
+            self.m[skey], self.v[skey] = m, v
 
     # ------------------------------------------------------------------
     def step(self, grads: Any, params: Any, step_num: int):
@@ -105,29 +177,59 @@ class HostOffloadOptimizer:
         ``skipped=True`` (non-finite grad norm, fp16 overflow) leaves every state
         untouched — the engine keeps its params and shrinks the loss scale."""
         host_grads, order = self._snapshot_grads(grads)
-        skipped = self._host_work(host_grads, order, step_num)
+        gnorm = self._device_gnorm(grads)
+        skipped = self._host_work(host_grads, order, step_num, gnorm)
         if skipped:
             return params, True
         return self._upload(params), False
 
     def _snapshot_grads(self, grads):
-        """D2H of the grad tree (main thread — the jax client is not touched
-        from the worker). copy_to_host_async first so leaf transfers overlap
-        each other."""
-        names_leaves = _leaf_paths(grads)
-        for _, g in names_leaves:
-            if hasattr(g, "copy_to_host_async"):
-                g.copy_to_host_async()
-        host_grads = {n: np.asarray(jax.device_get(g), np.float32)
-                      for n, g in names_leaves}
-        return host_grads, [n for n, _ in names_leaves]
+        """D2H of the grad tree per UNIQUE param shard (main thread — the jax
+        client is not touched from the worker). When a grad leaf carries the
+        same shard layout as its param, each shard transfers directly
+        (replicas deduplicated — D2H volume is the sharded size, not the
+        global size); otherwise the leaf is fetched whole and sliced."""
+        host_grads: Dict[str, np.ndarray] = {}
+        order: List[str] = []
+        for name, g in _leaf_paths(grads):
+            layout = self._layout[name]
+            g_shards = {_index_key(sh.index, g.shape): sh.data
+                        for sh in getattr(g, "addressable_shards", [])}
+            matches = self._sharded_tier and all(
+                key in g_shards for key, _ in layout)
+            if matches:
+                for _, data in sorted(g_shards.items()):
+                    if hasattr(data, "copy_to_host_async"):
+                        data.copy_to_host_async()
+                for i, (key, _devs) in enumerate(layout):
+                    skey = f"{name}#{i}"
+                    host_grads[skey] = np.asarray(
+                        jax.device_get(g_shards[key]), np.float32)
+                    order.append(skey)
+            else:  # layout mismatch: fetch whole, slice per shard index
+                full = np.asarray(jax.device_get(g), np.float32)
+                for i, (key, _devs) in enumerate(layout):
+                    skey = f"{name}#{i}"
+                    host_grads[skey] = np.ascontiguousarray(
+                        full[_key_slices(key)])
+                    order.append(skey)
+        return host_grads, order
 
-    def _host_work(self, host_grads, order, step_num) -> bool:
-        """gnorm + clip + fused Adam over the host buffers (pure numpy/C++ —
-        safe on the background worker). Returns skipped."""
+    def _device_gnorm(self, grads) -> float:
+        """Global grad norm computed ON DEVICE from the (global) grad arrays
+        — correct on a multi-host pod, where host buffers only cover this
+        process's shards. Main thread only (touches the jax client)."""
+        import jax.numpy as jnp
+
+        sq = sum(jnp.vdot(g.astype(jnp.float32), g.astype(jnp.float32))
+                 for _, g in _leaf_paths(grads))
+        return float(jnp.sqrt(sq))
+
+    def _host_work(self, host_grads, order, step_num, gnorm: float) -> bool:
+        """clip + fused Adam over the host buffers (pure numpy/C++ — safe on
+        the background worker; ``gnorm`` precomputed on the main thread).
+        Returns skipped."""
         lr = float(self.schedule_fn(step_num)) if self.schedule_fn else self.base_lr
-        gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
-                                  for g in host_grads.values())))
         self._last_gnorm = gnorm
         if not np.isfinite(gnorm):
             return True
@@ -170,13 +272,30 @@ class HostOffloadOptimizer:
                                lr=lr, increment=False)
 
     def _upload(self, params: Any):
-        """masters → device, preserving each leaf's sharding + dtype."""
-        leaves = dict(_leaf_paths(params))
+        """masters → device per shard, preserving each leaf's sharding +
+        dtype (H2D volume = the sharded size; replicas re-materialize on
+        device from the one host buffer)."""
         copy = _aliasing_backend()  # device_put must not alias the mutable master
         new_flat = {}
-        for name, leaf in leaves.items():
-            arr = self.master[name].astype(leaf.dtype, copy=copy)
-            new_flat[name] = jax.device_put(arr.reshape(leaf.shape), leaf.sharding)
+        for name, leaf in _leaf_paths(params):
+            layout = self._layout[name]
+            if layout[0][1] is None:  # legacy full-leaf tier
+                arr = self.master[f"{name}#0"].astype(leaf.dtype, copy=copy)
+                new_flat[name] = jax.device_put(arr.reshape(leaf.shape),
+                                                leaf.sharding)
+                continue
+            target = self._state_sh.get(name, leaf.sharding)
+            bufs = []
+            for i, (key, devs) in enumerate(layout):
+                arr = self.master[f"{name}#{i}"].astype(leaf.dtype, copy=copy)
+                for d in devs:
+                    bufs.append(jax.device_put(arr, d))
+            sharded = jax.make_array_from_single_device_arrays(
+                leaf.shape, target, bufs)
+            # H2D moved only the state shards; re-materializing the (possibly
+            # replicated) param layout is a device-side collective
+            new_flat[name] = (sharded if target == leaf.sharding
+                              else jax.device_put(sharded, leaf.sharding))
         treedef = jax.tree_util.tree_structure(params)
         ordered = [new_flat[n] for n, _ in _leaf_paths(params)]
         return jax.tree_util.tree_unflatten(treedef, ordered)
@@ -195,7 +314,9 @@ class HostOffloadOptimizer:
         serializes badly against the main dispatch stream."""
         assert self._pending is None, "previous async step not collected"
         host_grads, order = self._snapshot_grads(grads)
-        fut = self._worker.submit(self._host_work, host_grads, order, step_num)
+        gnorm = self._device_gnorm(grads)
+        fut = self._worker.submit(self._host_work, host_grads, order, step_num,
+                                  gnorm)
         self._pending = (fut, params)
 
     def finish_pending(self):
@@ -215,20 +336,54 @@ class HostOffloadOptimizer:
     def state_dict(self) -> Dict[str, np.ndarray]:
         return self._state_dict_base()
 
+    def _shard_get(self, kind: str, skey: str) -> np.ndarray:
+        if kind == "master":
+            return self.master[skey]
+        if self.swapper is not None:
+            return self.swapper.swap_in(f"{skey}.{kind}")
+        return getattr(self, kind)[skey]
+
+    def _full_leaf(self, kind: str, name: str) -> np.ndarray:
+        """Reassemble a leaf's full host array from its shard buffers (the
+        checkpoint format stays topology-independent full arrays)."""
+        layout = self._layout[name]
+        full_key = tuple((0, s) for s in self._shapes[name])
+        if len(layout) == 1 and layout[0][0] == full_key:
+            return self._shard_get(kind, f"{name}#0")
+        full = np.zeros(self._shapes[name], np.float32)
+        for i, (key, _d) in enumerate(layout):
+            full[_key_slices(key)] = self._shard_get(kind, f"{name}#{i}")
+        return full
+
+    def _set_full_leaf(self, kind: str, name: str, val: np.ndarray) -> None:
+        val = np.asarray(val, np.float32).reshape(self._shapes[name])
+        for i, (key, _d) in enumerate(self._layout[name]):
+            skey = f"{name}#{i}"
+            piece = np.array(val[_key_slices(key)], np.float32)  # owned copy
+            if kind == "master":
+                self.master[skey] = piece
+            elif self.swapper is not None:
+                self.swapper.swap_out(f"{skey}.{kind}", piece)
+            else:
+                getattr(self, kind)[skey] = piece
+
     def _state_dict_base(self) -> Dict[str, np.ndarray]:
         assert self._pending is None, (
             "flush the async step (engine.step boundary) before checkpointing")
+        if jax.process_count() > 1:
+            # each process holds only its addressable shards; consolidating
+            # would silently zero-fill remote ranges — fail loudly until a
+            # cross-process gather lands
+            raise NotImplementedError(
+                "sharded host-tier checkpoint consolidation across processes "
+                "is not implemented; save per-process or gather externally")
         out = {"step": np.int64(self.adam.step_count)}
-        for name in self.master:
-            # no copy: _pending is drained (asserted above) and the caller
-            # writes synchronously, so no later step can race this snapshot
-            out["master/" + name] = self.master[name]
-            if self.swapper is not None:
-                out["m/" + name] = self.swapper.swap_in(name + ".m")
-                out["v/" + name] = self.swapper.swap_in(name + ".v")
-            else:
-                out["m/" + name] = self.m[name]
-                out["v/" + name] = self.v[name]
+        for name in self._layout:
+            # reassembled full arrays: the on-disk format is independent of
+            # the host tier's shard layout (universal-checkpoint friendly)
+            out["master/" + name] = self._full_leaf("master", name)
+            out["m/" + name] = self._full_leaf("m", name)
+            out["v/" + name] = self._full_leaf("v", name)
         return out
 
     def load_state_dict(self, sd: Dict[str, np.ndarray]) -> None:
@@ -237,12 +392,8 @@ class HostOffloadOptimizer:
             if key == "step":
                 continue
             kind, name = key.split("/", 1)
-            if kind == "master":
-                self.master[name] = np.array(val, np.float32)  # owned copy
-            elif self.swapper is not None:
-                self.swapper.swap_out(name + "." + kind, np.ascontiguousarray(val))
-            else:
-                getattr(self, kind)[name] = np.ascontiguousarray(val, np.float32)
+            if name in self._layout:
+                self._set_full_leaf(kind, name, val)
         if self.swapper is not None:
             self.swapper.wait()
 
@@ -277,6 +428,10 @@ class ZenFlowSelectiveOptimizer(HostOffloadOptimizer):
                  select_interval: int = 16, update_interval: int = 4,
                  full_warm_up_rounds: int = 0, **kw):
         assert 0.0 < topk_ratio <= 1.0
+        # the selective split keys host state by whole leaves (column merges
+        # need the full master); the fsdp-sharded host tier applies to the
+        # plain offload path only
+        kw.setdefault("shard_host_tier", False)
         super().__init__(params, **kw)
         self.topk_ratio = float(topk_ratio)
         self.select_interval = int(select_interval)
@@ -433,29 +588,30 @@ class ZenFlowSelectiveOptimizer(HostOffloadOptimizer):
               else self.base_lr)
         self.adam.step_count += 1
         for n in self._sel_names:
+            sk = f"{n}#0"          # legacy full-leaf host-tier key
             if self.swapper is not None:  # nvme moments tier
-                m = self.swapper.swap_in(n + ".m")
-                v = self.swapper.swap_in(n + ".v")
+                m = self.swapper.swap_in(sk + ".m")
+                v = self.swapper.swap_in(sk + ".v")
             else:
-                m, v = self.m[n], self.v[n]
-            self.adam.step(self.master[n].reshape(-1),
+                m, v = self.m[sk], self.v[sk]
+            self.adam.step(self.master[sk].reshape(-1),
                            host_grads[n].reshape(-1), m.reshape(-1),
                            v.reshape(-1), lr=lr, increment=False)
             if self.swapper is not None:
-                self.swapper.swap_out(n + ".m", m)
-                self.swapper.swap_out(n + ".v", v)
+                self.swapper.swap_out(sk + ".m", m)
+                self.swapper.swap_out(sk + ".v", v)
         if self.swapper is not None:
             self.swapper.wait()
         masters_dev = {n: jax.device_put(
-            self.master[n].astype(np.float32),
+            self.master[f"{n}#0"].astype(np.float32),
             flat_p[n].sharding) for n in self._sel_names}
         merged = self._jit_merge(flat_p, masters_dev, self._idx)
         # refresh masters so BOTH column sets are current on the host
         for n in self._sel_names:
-            self.master[n] = np.ascontiguousarray(
+            self.master[f"{n}#0"] = np.ascontiguousarray(
                 np.asarray(jax.device_get(merged[n]), np.float32))
         for n in self._full_names:
-            self.master[n] = np.ascontiguousarray(
+            self.master[f"{n}#0"] = np.ascontiguousarray(
                 np.asarray(jax.device_get(flat_p[n]), np.float32))
         self._acc = jax.tree_util.tree_map(jnp.zeros_like, self._acc)
         if step_num + 1 - getattr(self, "_last_select", 0) >= \

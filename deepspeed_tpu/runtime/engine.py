@@ -590,6 +590,9 @@ class DeepSpeedTpuEngine:
             schedule_fn=schedule_fn,
             nvme_path=off.nvme_path if off.device == "nvme" else None,
             aio_threads=off.buffer_count)
+        self._offload_unscale = jax.jit(
+            lambda t, d: jax.tree_util.tree_map(lambda g: g / d, t),
+            out_shardings=self.grad_sharding)
         if selective:
             self._offload = ZenFlowSelectiveOptimizer(
                 self.params, topk_ratio=zf.topk_ratio,
@@ -597,9 +600,9 @@ class DeepSpeedTpuEngine:
                 update_interval=zf.resolved_update_interval(),
                 full_warm_up_rounds=zf.full_warm_up_rounds, **common)
         else:
-            self._offload = HostOffloadOptimizer(self.params,
-                                                 overlap_step=overlap,
-                                                 **common)
+            self._offload = HostOffloadOptimizer(
+                self.params, overlap_step=overlap,
+                state_shardings=self.grad_sharding, **common)
 
     def step(self, *args, **kwargs):
         """Optimizer step at the GA boundary — engine.py:3241."""
@@ -609,8 +612,11 @@ class DeepSpeedTpuEngine:
             ga = float(self.config.gradient_accumulation_steps)
             denom = ga * float(self.scaler_state["scale"])  # unscale fp16 loss scale
             with jax.sharding.set_mesh(self.mesh):
-                grads = (self._grad_acc if denom == 1.0 else jax.tree_util.tree_map(
-                    lambda g: g / denom, self._grad_acc))
+                # keep the grad sharding through the unscale so the offload
+                # tier's per-shard D2H fast path matches its layout
+                grads = (self._grad_acc if denom == 1.0
+                         else self._offload_unscale(self._grad_acc,
+                                                    jnp.float32(denom)))
             if self._offload.overlap:
                 self._collect_offload()
                 # snapshot BEFORE launching: the worker overwrites _last_gnorm

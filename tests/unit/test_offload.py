@@ -234,7 +234,7 @@ class TestZenFlowSelective:
             else:  # boundary (step+1) % 3 == 0: host update lands
                 assert np.abs(cur["w"][:, unsel] - prev["w"][:, unsel]).max() > 0
         # masters mirror device params after the boundary
-        np.testing.assert_allclose(opt.master["w"], np.asarray(p["w"]),
+        np.testing.assert_allclose(opt.master["w#0"], np.asarray(p["w"]),
                                    rtol=1e-6)
 
     def test_reselection_and_state_dict(self):
@@ -349,3 +349,66 @@ class TestZenFlowSelective:
         # and recovery works
         p3, skipped = opt.step(g_ok, p2, 2)
         assert not skipped and np.isfinite(np.asarray(p3["w"])).all()
+
+
+@requires_native
+class TestShardedHostTier:
+    """Round-2 gap #6: the host tier is partitioned by param shard (reference
+    stage_1_and_2 cpu_offload partitioning) — per-host RAM and D2H volume
+    follow the fsdp shard size, replicas deduplicated."""
+
+    def test_masters_stored_per_fsdp_shard(self, eight_devices):
+        model = TransformerLM(get_preset("tiny"))
+        eng, *_ = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "mesh": {"fsdp": 4, "dp": 2},
+            "steps_per_print": 100})
+        b = {"input_ids": np.random.default_rng(0).integers(
+            0, 256, (2 * eng.topology.dp_world_size, 16))}
+        loss = eng.forward(b); eng.backward(loss); eng.step()
+        opt = eng._offload
+        # a ZeRO-sharded leaf stores fsdp buffers, each 1/fsdp of the leaf
+        sharded = [n for n in opt._layout
+                   if len(opt._layout[n]) == 4]
+        assert sharded, "no leaf sharded into 4 host buffers"
+        name = sharded[0]
+        total = int(np.prod(opt._shapes[name]))
+        for i in range(4):
+            assert opt.master[f"{name}#{i}"].size == total // 4
+        # replicated leaves (dp replicas) are stored ONCE, not 8x
+        assert all(len(v) <= 4 for v in opt._layout.values())
+        host_elems = sum(a.size for a in opt.master.values())
+        model_elems = sum(int(np.prod(s)) for s in opt._shapes.values())
+        assert host_elems == model_elems  # all shards present, none duplicated
+
+    def test_sharded_tier_checkpoint_roundtrip(self, eight_devices):
+        model = TransformerLM(get_preset("tiny"))
+        cfgd = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "mesh": {"fsdp": 4, "dp": 2},
+            "steps_per_print": 100}
+        eng, *_ = ds.initialize(model=model, config=cfgd)
+        b = {"input_ids": np.random.default_rng(1).integers(
+            0, 256, (2 * eng.topology.dp_world_size, 16))}
+        loss = eng.forward(b); eng.backward(loss); eng.step()
+        sd = eng._offload.state_dict()
+        # the checkpoint format is full arrays (topology-independent)
+        for name, shape in eng._offload._shapes.items():
+            assert sd["master/" + name].shape == tuple(shape)
+        eng2, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                 config=cfgd)
+        eng2.forward(b)  # build grads/opt
+        eng2._offload.load_state_dict(sd)
+        for name in eng._offload._layout:
+            np.testing.assert_allclose(
+                eng2._offload._full_leaf("master", name),
+                eng._offload._full_leaf("master", name), rtol=1e-7)
+            np.testing.assert_allclose(
+                eng2._offload._full_leaf("m", name),
+                eng._offload._full_leaf("m", name), rtol=1e-7)
