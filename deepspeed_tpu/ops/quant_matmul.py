@@ -1,0 +1,144 @@
+"""Fused dequantize-matmul (W4A16 / W8A16) Pallas kernel.
+
+Parity target: ``deepspeed/inference/v2/kernels/cutlass_ops/mixed_gemm`` — the
+CUTLASS mixed-input GEMM that multiplies bf16 activations against int4/int8
+weights, dequantizing inside the kernel. TPU-native design: the packed weight
+tile and its per-group scales are DMA'd to VMEM by the Pallas pipeline, the
+nibbles are expanded and scaled in registers, and the MXU consumes the bf16
+tile directly — the full-precision weight matrix never exists in HBM, so the
+weight-read bandwidth (the serving bottleneck at decode batch sizes) drops by
+4x (int4) / 2x (int8) against a bf16 GEMM.
+
+Weight layout (``quantize_matmul_weight``): the contraction dim D is split
+into groups of ``group`` rows sharing one fp32 scale per output column
+(scales ``[D/group, F]``). int4 packs two rows per byte block-deinterleaved
+WITHIN each group — byte row r of group g holds row ``2g*h + r`` in its low
+nibble and row ``2g*h + r + h`` (h = group/2) in the high nibble — so the
+kernel reconstructs a group with one contiguous concat (sublane interleaves
+do not lower on Mosaic).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _on_tpu() -> bool:
+    from deepspeed_tpu.ops import OpBuilder  # single source of backend truth
+
+    return OpBuilder.on_tpu()
+
+
+def quantize_matmul_weight(w: jax.Array, bits: int = 4, group: int = 128
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """``w`` [D, F] → (packed int8 [D/2, F] (int4) or [D, F] (int8),
+    scales fp32 [D/group, F]) in the kernel's layout."""
+    assert bits in (4, 8)
+    D, F = w.shape
+    assert D % group == 0, f"D={D} must divide by group={group}"
+    wf = w.astype(jnp.float32).reshape(D // group, group, F)
+    qmax = 7 if bits == 4 else 127
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=1) / qmax, 1e-12)  # [G, F]
+    q = jnp.clip(jnp.round(wf / scale[:, None]), -qmax - 1, qmax)
+    if bits == 8:
+        return q.astype(jnp.int8).reshape(D, F), scale
+    h = group // 2
+    lo = q[:, :h].astype(jnp.int8)          # rows [0, h) of each group
+    hi = q[:, h:].astype(jnp.int8)          # rows [h, group)
+    packed = (lo & 0x0F) | ((hi & 0x0F) << 4)
+    return packed.reshape(D // 2, F), scale
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, bits: int, group: int,
+                n_d: int):
+    d = pl.program_id(1)
+
+    @pl.when(d == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    q = q_ref[:]                            # int8 [group(/2), bf]
+    s = s_ref[0]                            # fp32 [1, bf]
+    if bits == 4:
+        # nibble unpack in float arithmetic: Mosaic does not legalize int8
+        # vector shifts (arith.shli), and -128..127 is exact in fp32
+        qf = q.astype(jnp.float32)
+        u = qf + 256.0 * (qf < 0)           # unsigned byte value
+        hi_n = jnp.floor(u / 16.0)
+        lo_n = u - 16.0 * hi_n
+        lo = lo_n - 16.0 * (lo_n >= 8)      # sign-extend nibbles
+        hi = hi_n - 16.0 * (hi_n >= 8)
+        wt = jnp.concatenate([lo, hi], axis=0)   # [group, bf]
+    else:
+        wt = q.astype(jnp.float32)
+    wt = (wt * s).astype(jnp.bfloat16)
+    acc[:] += jax.lax.dot_general(
+        x_ref[:], wt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(d == n_d - 1)
+    def _done():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def quantized_matmul(x: jax.Array, packed: jax.Array, scales: jax.Array,
+                     bits: int = 4, block_f: int = 512,
+                     interpret: bool = None) -> jax.Array:
+    """``x`` [B, D] @ dequant(packed, scales) → [B, F], weights expanded only
+    in VMEM. Falls back to the XLA dequant-then-matmul outside the kernel's
+    sweet spot (tiny shapes, non-TPU geometries)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    B, D = x.shape
+    G, F = scales.shape
+    group = D // G
+    assert packed.shape[0] == (D // 2 if bits == 4 else D)
+    if D % 128 or F % 128 or group % 128 or B > 1024:
+        return x @ dequantize_matmul_weight(packed, scales, bits, D)
+    bf = min(block_f, F)
+    while F % bf:
+        bf //= 2
+    if bf % 128:
+        return x @ dequantize_matmul_weight(packed, scales, bits, D)
+    rows = group // 2 if bits == 4 else group
+    kernel = functools.partial(_qmm_kernel, bits=bits, group=group, n_d=G)
+    grid = (F // bf, G)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, group), lambda f, d: (0, d)),
+            pl.BlockSpec((rows, bf), lambda f, d: (d, f)),
+            pl.BlockSpec((1, 1, bf), lambda f, d: (d, 0, f)),
+        ],
+        out_specs=pl.BlockSpec((B, bf), lambda f, d: (0, f)),
+        out_shape=jax.ShapeDtypeStruct((B, F), x.dtype),
+        scratch_shapes=[pltpu.VMEM((B, bf), jnp.float32)],
+        interpret=interpret,
+    )(x, packed, scales.astype(jnp.float32).reshape(G, 1, F))
+    return out
+
+
+def dequantize_matmul_weight(packed: jax.Array, scales: jax.Array,
+                             bits: int, D: int) -> jax.Array:
+    """Expand the kernel's weight layout back to dense (reference path for
+    parity tests and the off-sweet-spot fallback)."""
+    G, F = scales.shape
+    group = D // G
+    if bits == 8:
+        q = packed.reshape(G, group, F).astype(jnp.float32)
+    else:
+        h = group // 2
+        b = packed.reshape(G, h, F)
+        lo = ((b << 4).astype(jnp.int8) >> 4).astype(jnp.float32)
+        hi = (b >> 4).astype(jnp.float32)
+        q = jnp.concatenate([lo, hi], axis=1)        # [G, group, F]
+    w = q * scales[:, None]
+    return w.reshape(D, F).astype(jnp.bfloat16)

