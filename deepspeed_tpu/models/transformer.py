@@ -382,8 +382,11 @@ def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
     dt = jnp.dtype(cfg.dtype)
     wc = jax.tree_util.tree_map(lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, w)
     hn1 = _norm(x, wc["ln1"], cfg.norm, cfg.norm_eps)
-    attn_out = attention_block(hn1, wc["attn"], cfg, freqs, attn_fn,
-                               positions=positions)
+    # named scopes land in HLO op metadata — the per-module profiler
+    # (profiling/flops_profiler.per_module_profile) groups cost by them
+    with jax.named_scope("attn"):
+        attn_out = attention_block(hn1, wc["attn"], cfg, freqs, attn_fn,
+                                   positions=positions)
     if cfg.parallel_block:
         # falcon/gpt-neox: attn and mlp branch from the SAME residual input
         h = hn1 if cfg.parallel_shared_norm else _norm(x, wc["ln2"], cfg.norm,
@@ -392,9 +395,12 @@ def transformer_block(x: jax.Array, w: Params, cfg: TransformerConfig,
         x = x + attn_out
         h = _norm(x, wc["ln2"], cfg.norm, cfg.norm_eps)
     if moe_fn is not None:
-        mlp_out, aux = moe_fn(h, wc["mlp"], cfg)
+        with jax.named_scope("moe"):
+            mlp_out, aux = moe_fn(h, wc["mlp"], cfg)
     else:
-        mlp_out, aux = mlp_block(h, wc["mlp"], cfg), jnp.zeros((), jnp.float32)
+        with jax.named_scope("mlp"):
+            mlp_out = mlp_block(h, wc["mlp"], cfg)
+        aux = jnp.zeros((), jnp.float32)
     x = x + mlp_out + attn_out if cfg.parallel_block else x + mlp_out
     return constrain(x, P(("dp", "fsdp"), "sp", None)), aux
 
@@ -532,7 +538,9 @@ class TransformerLM:
 
     def _project(self, params: Params, hidden: jax.Array) -> jax.Array:
         """hidden [B, T, D] → logits [B, T, V] with the canonical sharding."""
-        logits = hidden @ self._head(params).astype(jnp.dtype(self.cfg.dtype))
+        with jax.named_scope("lm_head"):
+            logits = hidden @ self._head(params).astype(
+                jnp.dtype(self.cfg.dtype))
         return constrain(logits, P(("dp", "fsdp"), "sp", "tp"))
 
     def logits(self, params: Params, input_ids: jax.Array,

@@ -6,4 +6,6 @@ already knows: XLA's HLO cost analysis gives exact flops/bytes for the *optimize
 program, and ``jax.profiler`` produces xprof traces (the NVTX/nsys analog).
 """
 
-from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, profile_fn  # noqa: F401
+from deepspeed_tpu.profiling.flops_profiler import (  # noqa: F401
+    FlopsProfiler, per_module_profile, profile_fn,
+)

@@ -309,3 +309,26 @@ class TestSanityChecks:
 
         eng.params["counter"] = jnp.zeros((4,), jnp.int32)
         check_param_integrity(eng)  # must not raise on integer leaves
+
+
+def test_per_module_profile_attributes_blocks(eight_devices):
+    """Round-2 weak #9: the profiler now breaks cost down per named module
+    (the reference profiler's 'top modules' view) instead of whole-program
+    totals only."""
+    import jax
+
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.profiling import per_module_profile
+
+    model = TransformerLM(get_preset("tiny"))
+    params = model.init(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, 256, (2, 16))
+    mods = per_module_profile(lambda p: model.logits(p, ids), params)
+    scopes = set(mods)
+    assert any(s.startswith("mlp") for s in scopes), scopes
+    assert any(s.startswith("attn") for s in scopes), scopes
+    assert any("lm_head" in s for s in scopes), scopes
+    # the mlp is the FLOPs-heaviest block of a dense decoder layer
+    top = next(iter(mods))
+    assert top.startswith("mlp"), mods
+    assert all(v["gflops"] >= 0 and v["ops"] > 0 for v in mods.values())
