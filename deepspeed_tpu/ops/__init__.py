@@ -106,6 +106,9 @@ def _register_model_attention() -> None:
 
     tfm.register_attention_impl("ulysses", ulysses_attention_spmd)
     tfm.register_attention_impl("ring", ring_attention_spmd)
+    from deepspeed_tpu.sequence.fpdt import fpdt_attention
+
+    tfm.register_attention_impl("fpdt", fpdt_attention)
 
 
 _register_model_attention()
