@@ -61,8 +61,35 @@ def initialize(model=None, config=None, optimizer=None, model_parameters=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Build the inference engine (parity: ``deepspeed.init_inference`` __init__.py:328)."""
+def init_inference(model=None, config=None, checkpoint=None, dtype=None,
+                   **kwargs):
+    """Build the inference engine (parity: ``deepspeed.init_inference``
+    __init__.py:328, incl. the ``checkpoint=`` loading surface of
+    ``inference/engine.py:303``).
+
+    ``checkpoint`` accepts either an engine checkpoint directory (written by
+    ``engine.save_checkpoint``; pass the ``model``) or a HuggingFace
+    checkpoint directory (``config.json`` + safetensors; ``model`` may be
+    omitted — the family importer builds it).
+    """
+    import os as _os
+
     from deepspeed_tpu.inference.engine import InferenceEngine
 
+    if checkpoint is not None and "params" not in kwargs:
+        if _os.path.exists(_os.path.join(checkpoint, "config.json")):
+            from deepspeed_tpu.models.hf import load_hf_checkpoint
+
+            hf_model, params = load_hf_checkpoint(
+                checkpoint, dtype=dtype or "float32")
+            model = model if model is not None else hf_model
+            kwargs["params"] = params
+        else:
+            if model is None:
+                raise ValueError(
+                    "init_inference(checkpoint=<engine checkpoint>) needs "
+                    "the model; only HF checkpoint dirs are self-describing")
+            from deepspeed_tpu.runtime.checkpoint import load_params_only
+
+            kwargs["params"] = load_params_only(checkpoint)
     return InferenceEngine(model=model, config=config, **kwargs)

@@ -224,6 +224,20 @@ def consolidate_to_fp32(load_dir: str, tag: Optional[str] = None,
     return flat
 
 
+def load_params_only(load_dir: str, tag: Optional[str] = None):
+    """Restore just the parameter tree from an engine checkpoint — the
+    ``init_inference(checkpoint=...)`` loading surface (reference
+    ``inference/engine.py:303`` checkpoint loading). Offline: no engine."""
+    import orbax.checkpoint as ocp
+
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no checkpoint 'latest' tag under {load_dir}")
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(os.path.join(_tag_dir(load_dir, tag), "state"))
+    return state["params"]
+
+
 def save_16bit_model(engine, save_dir: str, filename: str = "model_fp16.npz") -> str:
     """Rank-0 consolidated bf16 export (engine.py:5285 ``save_16bit_model`` parity)."""
     os.makedirs(save_dir, exist_ok=True)

@@ -206,6 +206,18 @@ class DeepSpeedTpuEngine:
 
             self._curriculum = CurriculumScheduler(
                 de.data_sampling.curriculum_learning.model_dump())
+            # every distinct difficulty value is a distinct jit shape: a
+            # fine-grained schedule would silently thrash the compile cache
+            n_buckets = (self._curriculum.max_difficulty
+                         - self._curriculum.min_difficulty) \
+                // max(self._curriculum.difficulty_step, 1) + 1
+            if n_buckets > 64:
+                raise ValueError(
+                    f"curriculum_learning would create {n_buckets} distinct "
+                    "sequence-length buckets (each one a fresh XLA compile); "
+                    "raise schedule_config.difficulty_step so "
+                    "(max_difficulty - min_difficulty) / difficulty_step "
+                    "<= 64")
         if de.enabled and de.data_routing.enabled \
                 and de.data_routing.random_ltd.enabled:
             self._ltd_cfg = de.data_routing.random_ltd

@@ -157,3 +157,24 @@ def test_random_ltd_engine(eight_devices):
     dense = build(False)
     ld = float(dense.forward(batch))
     np.testing.assert_allclose(l2, ld, rtol=1e-5)
+
+
+def test_curriculum_bucket_count_guarded(eight_devices):
+    """Round-2 weak #6: a fine-grained difficulty schedule would thrash the
+    jit cache one compile per distinct sequence length — the engine now
+    rejects schedules with more than 64 shape buckets."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    with pytest.raises(ValueError, match="buckets"):
+        ds.initialize(model=TransformerLM(get_preset("tiny")), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+            "steps_per_print": 100,
+            "data_efficiency": {"enabled": True, "data_sampling": {
+                "enabled": True, "curriculum_learning": {
+                    "enabled": True, "min_difficulty": 8,
+                    "max_difficulty": 1024,
+                    "schedule_config": {"difficulty_step": 1,
+                                        "total_curriculum_step": 100}}}}})

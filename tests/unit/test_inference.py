@@ -472,3 +472,48 @@ def test_joint_capacity_rejected_before_any_scheduling(tiny_lm):
     # a single prompt still fits
     eng.put([1], [p])
     assert eng.state.sequences[1].seen_tokens == 64
+
+
+def test_init_inference_checkpoint_surfaces(tmp_path, eight_devices):
+    """init_inference(checkpoint=...) loads engine checkpoints (given the
+    model) and HF checkpoint dirs (self-describing) — round-2 weak #7."""
+    import deepspeed_tpu as ds
+
+    # engine checkpoint route
+    model = TransformerLM(get_preset("tiny"))
+    eng, *_ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+        "steps_per_print": 100})
+    b = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 16))}
+    loss = eng.forward(b); eng.backward(loss); eng.step()
+    ck = str(tmp_path / "engine_ck")
+    eng.save_checkpoint(ck)
+    ieng = ds.init_inference(model=TransformerLM(get_preset("tiny")),
+                             checkpoint=ck, config={"mesh": {}})
+    trained = np.asarray(jax.tree_util.tree_leaves(eng.params)[0])
+    loaded = np.asarray(jax.tree_util.tree_leaves(ieng.params)[0])
+    np.testing.assert_allclose(loaded, trained, rtol=1e-6)
+    out = ieng.generate(np.random.default_rng(1).integers(0, 256, (1, 4)),
+                        max_new_tokens=3)
+    assert out.shape == (1, 7)
+
+    # HF checkpoint route (model auto-built)
+    import torch
+    import transformers as tr
+
+    torch.manual_seed(0)
+    hf = tr.LlamaForCausalLM(tr.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32))
+    hf_dir = str(tmp_path / "hf_ck")
+    hf.save_pretrained(hf_dir)
+    ieng2 = ds.init_inference(checkpoint=hf_dir, config={"mesh": {}})
+    ids = np.random.default_rng(2).integers(0, 128, (1, 8))
+    out2 = np.asarray(ieng2.generate(ids, max_new_tokens=3))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids), max_new_tokens=3,
+                          do_sample=False).numpy()
+    np.testing.assert_array_equal(out2, ref)
