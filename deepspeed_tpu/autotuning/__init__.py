@@ -7,3 +7,6 @@ needed: one trial == one XLA program).
 """
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401
+from deepspeed_tpu.autotuning.scheduler import (  # noqa: F401
+    Experiment, ExperimentScheduler, ResourceManager, subprocess_runner,
+)

@@ -1,0 +1,163 @@
+"""Experiment scheduler: tuning trials fanned out over a resource pool.
+
+Parity target: ``deepspeed/autotuning/scheduler.py`` — ``ResourceManager``
+(hostfile slots → reservations) + the experiment queue that launches each
+candidate config as its own job, harvests the metric files, and writes the
+winning config back. The in-process :class:`~.autotuner.Autotuner` stays the
+single-host fast path; this scheduler is the multi-host form: experiments run
+through a pluggable runner (by default a subprocess launching the user's
+training script with ``--deepspeed_config <exp.json>`` through the launcher's
+transports), so concurrent trials land on disjoint host sets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist
+
+METRIC_FILE = "autotune_metric.json"
+BEST_FILE = "best_config.json"
+
+
+@dataclasses.dataclass
+class Experiment:
+    exp_id: int
+    config: Dict[str, Any]
+    num_hosts: int = 1
+    status: str = "pending"          # pending|running|done|failed
+    metric: float = float("nan")
+    hosts: Tuple[str, ...] = ()
+    error: str = ""
+
+
+class ResourceManager:
+    """Host pool with reservations (scheduler.py ``ResourceManager``)."""
+
+    def __init__(self, hosts: Sequence[str]):
+        self._free = list(hosts)
+        self._cond = threading.Condition()
+
+    def reserve(self, n: int) -> Optional[Tuple[str, ...]]:
+        with self._cond:
+            if len(self._free) < n:
+                return None
+            alloc = tuple(self._free[:n])
+            del self._free[:n]
+            return alloc
+
+    def release(self, alloc: Tuple[str, ...]) -> None:
+        with self._cond:
+            self._free.extend(alloc)
+            self._cond.notify_all()
+
+    def wait_for_capacity(self, timeout: float = 1.0) -> None:
+        with self._cond:
+            self._cond.wait(timeout)
+
+
+def subprocess_runner(script: str, extra_args: Sequence[str] = ()):
+    """Default experiment runner: launch ``script`` with the experiment's
+    config and read the metric it writes to ``<exp_dir>/autotune_metric.json``
+    (``{"metric": <float>}`` — the contract the reference's experiments keep
+    via their summary files). Multi-host allocations export
+    ``DSTPU_HOSTS`` for the script's own ``dstpu``-style launch."""
+
+    def run(exp: Experiment, exp_dir: str) -> float:
+        cfg_path = os.path.join(exp_dir, "exp_config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(exp.config, f, indent=2)
+        env = dict(os.environ)
+        env["DSTPU_HOSTS"] = ",".join(exp.hosts)
+        env["DSTPU_AUTOTUNE_DIR"] = exp_dir
+        proc = subprocess.run(
+            [sys.executable, script, "--deepspeed_config", cfg_path,
+             *extra_args],
+            env=env, capture_output=True, text=True, timeout=3600)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-500:])
+        with open(os.path.join(exp_dir, METRIC_FILE)) as f:
+            return float(json.load(f)["metric"])
+
+    return run
+
+
+class ExperimentScheduler:
+    """Queue of candidate configs over a host pool; ``run()`` keeps as many
+    experiments in flight as resources allow, records every result, and
+    writes the best config to ``<results_dir>/best_config.json``."""
+
+    def __init__(self, experiments: Sequence[Dict[str, Any]],
+                 hosts: Sequence[str], results_dir: str,
+                 runner: Optional[Callable[[Experiment, str], float]] = None,
+                 hosts_per_exp: int = 1):
+        self.experiments = [Experiment(i, dict(c), num_hosts=hosts_per_exp)
+                            for i, c in enumerate(experiments)]
+        self.rm = ResourceManager(hosts)
+        self.results_dir = results_dir
+        self.runner = runner
+        os.makedirs(results_dir, exist_ok=True)
+
+    def _run_one(self, exp: Experiment) -> None:
+        exp_dir = os.path.join(self.results_dir, f"exp_{exp.exp_id}")
+        os.makedirs(exp_dir, exist_ok=True)
+        try:
+            exp.metric = float(self.runner(exp, exp_dir))
+            exp.status = "done"
+        except Exception as e:
+            exp.status = "failed"
+            exp.error = str(e)[:300]
+        finally:
+            self.rm.release(exp.hosts)
+
+    def run(self) -> Optional[Experiment]:
+        assert self.runner is not None, "an experiment runner is required"
+        pool_size = len(self.rm._free)
+        pending = []
+        for exp in self.experiments:
+            if exp.num_hosts > pool_size:   # can never be scheduled
+                exp.status = "failed"
+                exp.error = (f"needs {exp.num_hosts} hosts, pool has "
+                             f"{pool_size}")
+            else:
+                pending.append(exp)
+        threads: List[threading.Thread] = []
+        while pending or threads:
+            threads = [t for t in threads if t.is_alive()]
+            progressed = False
+            for exp in list(pending):
+                alloc = self.rm.reserve(exp.num_hosts)
+                if alloc is None:
+                    break               # wait for a release
+                exp.hosts = alloc
+                exp.status = "running"
+                pending.remove(exp)
+                t = threading.Thread(target=self._run_one, args=(exp,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                progressed = True
+            if not progressed and threads:
+                self.rm.wait_for_capacity()  # woken by release(); no busy spin
+        done = [e for e in self.experiments
+                if e.status == "done" and not math.isnan(e.metric)]
+        for e in self.experiments:
+            log_dist(f"autotune exp {e.exp_id}: {e.status} "
+                     f"metric={e.metric:.3f} hosts={list(e.hosts)}"
+                     + (f" error={e.error}" if e.error else ""))
+        if not done:
+            return None
+        best = max(done, key=lambda e: e.metric)
+        with open(os.path.join(self.results_dir, BEST_FILE), "w") as f:
+            json.dump({"metric": best.metric, "exp_id": best.exp_id,
+                       "config": best.config}, f, indent=2)
+        log_dist(f"autotune best: exp {best.exp_id} metric={best.metric:.3f} "
+                 f"→ {os.path.join(self.results_dir, BEST_FILE)}")
+        return best

@@ -332,3 +332,60 @@ def test_per_module_profile_attributes_blocks(eight_devices):
     top = next(iter(mods))
     assert top.startswith("mlp"), mods
     assert all(v["gflops"] >= 0 and v["ops"] > 0 for v in mods.values())
+
+
+class TestExperimentScheduler:
+    """Multi-host autotuning scheduler (reference autotuning/scheduler.py):
+    experiments fan out over a host pool, failures are recorded not raised,
+    and the best config is written back."""
+
+    def test_parallel_scheduling_and_best_writeback(self, tmp_path):
+        import json
+        import threading
+
+        from deepspeed_tpu.autotuning import ExperimentScheduler
+
+        in_flight, peak = [0], [0]
+        lock = threading.Lock()
+
+        def runner(exp, exp_dir):
+            with lock:
+                in_flight[0] += 1
+                peak[0] = max(peak[0], in_flight[0])
+            try:
+                import time
+                time.sleep(0.05)
+                if exp.config["mb"] == 3:
+                    raise RuntimeError("simulated OOM")
+                return float(exp.config["mb"] * 10)
+            finally:
+                with lock:
+                    in_flight[0] -= 1
+
+        sched = ExperimentScheduler(
+            [{"mb": m} for m in (1, 2, 3, 4)],
+            hosts=["host-a", "host-b"], results_dir=str(tmp_path),
+            runner=runner)
+        best = sched.run()
+        assert best is not None and best.config == {"mb": 4}
+        assert peak[0] == 2              # both hosts were busy concurrently
+        statuses = {e.config["mb"]: e.status for e in sched.experiments}
+        assert statuses[3] == "failed" and statuses[4] == "done"
+        with open(tmp_path / "best_config.json") as f:
+            assert json.load(f)["config"] == {"mb": 4}
+
+    def test_multi_host_reservations(self, tmp_path):
+        from deepspeed_tpu.autotuning import ExperimentScheduler
+
+        seen = []
+
+        def runner(exp, exp_dir):
+            seen.append(tuple(sorted(exp.hosts)))
+            return 1.0
+
+        sched = ExperimentScheduler(
+            [{"i": 0}, {"i": 1}], hosts=["h0", "h1", "h2", "h3"],
+            results_dir=str(tmp_path), runner=runner, hosts_per_exp=2)
+        assert sched.run() is not None
+        assert all(len(h) == 2 for h in seen)
+        assert len(set(sum(map(list, seen), []))) == 4  # disjoint host sets
