@@ -273,6 +273,7 @@ class MonitorConfig(DSTpuConfigModel):
     tensorboard: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
+    comet: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
 
 
 class FlopsProfilerConfig(DSTpuConfigModel):

@@ -63,6 +63,25 @@ class WandbMonitor(_Backend):
             self.wandb.log({tag: value}, step=step)
 
 
+class CometMonitor(_Backend):
+    """Comet backend (reference monitor/monitor.py CometMonitor): degrades
+    gracefully when comet_ml is not installed (MonitorMaster logs and
+    continues, same as wandb)."""
+
+    def __init__(self, cfg):
+        import comet_ml  # optional
+
+        self.experiment = comet_ml.Experiment(
+            project_name=cfg.project or "deepspeed_tpu",
+            workspace=cfg.team)
+        if cfg.job_name:
+            self.experiment.set_name(cfg.job_name)
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        for tag, value, step in events:
+            self.experiment.log_metric(tag, value, step=step)
+
+
 class MonitorMaster:
     """Fan-out to all enabled backends; rank-0 only (monitor.py:30 parity)."""
 
@@ -75,7 +94,8 @@ class MonitorMaster:
             return
         for name, cls in (("csv_monitor", CSVMonitor),
                           ("tensorboard", TensorBoardMonitor),
-                          ("wandb", WandbMonitor)):
+                          ("wandb", WandbMonitor),
+                          ("comet", CometMonitor)):
             sub = getattr(config, name)
             if sub.enabled:
                 try:
