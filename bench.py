@@ -92,13 +92,29 @@ def main() -> None:
         tps = tokens_per_step * steps / dt
         return tps, tps * flops_per_token / peak, vals[-1]
 
-    for attempt in range(3):  # retry physically impossible readings
-        tokens_per_sec, mfu, last_loss = timed_run()
-        if mfu <= 1.0:
-            break
-    else:
-        raise RuntimeError(f"benchmark clock/runtime glitch: measured MFU "
-                           f"{mfu:.2f} > 1.0 on every attempt")
+    # One timing window is fragile: a transient host-load dip silently halves
+    # the reported number (round 3 lost 45% to exactly this). Take >=3
+    # windows, report the MEDIAN, and keep sampling while the inter-window
+    # spread exceeds 15% — a glitched window then shows up in `windows`/
+    # `spread` instead of becoming the headline.
+    windows, last_loss = [], 0.0
+    for attempt in range(9):
+        tps_i, mfu_i, last_loss = timed_run()
+        if mfu_i > 1.0:      # physically impossible: clock/runtime glitch
+            continue
+        windows.append(tps_i)
+        if len(windows) >= 3:
+            med = float(np.median(windows[-5:]))
+            spread = (max(windows[-5:]) - min(windows[-5:])) / med
+            if spread <= 0.15 or len(windows) >= 7:
+                break
+    if not windows:
+        raise RuntimeError("benchmark clock/runtime glitch: measured MFU "
+                           "> 1.0 on every attempt")
+    recent = windows[-5:]
+    tokens_per_sec = float(np.median(recent))
+    spread = (max(recent) - min(recent)) / tokens_per_sec
+    mfu = tokens_per_sec * flops_per_token / peak
 
     result = {
         "metric": "train_tokens_per_sec_per_chip",
@@ -111,6 +127,8 @@ def main() -> None:
             "loss": round(last_loss, 4),
             "device": getattr(dev, "device_kind", str(dev)),
             "batch": batch, "ga": ga, "seq": seq, "steps": steps,
+            "windows": [round(w, 1) for w in windows],
+            "spread": round(spread, 4),
         },
     }
 

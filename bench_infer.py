@@ -60,8 +60,10 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
                             max_seq_len=ctx, block_size=128)
     rng = np.random.default_rng(0)
     kv_bytes = int(eng.cache["k"].nbytes * 2)
+    # measure the SERVED tree (the engine casts fp32 masters to the compute
+    # dtype at construction) — the input `params` would double-count HBM
     param_bytes = int(sum(np.dtype(p.dtype).itemsize * p.size
-                          for p in jax.tree_util.tree_leaves(params)))
+                          for p in jax.tree_util.tree_leaves(eng.params)))
 
     # ---- prefill ----------------------------------------------------------
     # e2e: sequential put() calls (host packing + transfers included)
@@ -76,30 +78,26 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
     prefill_round(10_000)                      # warmup/compile
     prefill_e2e_tps = prefill_round(20_000)
 
-    # device rate: chained steps on device-resident inputs (async dispatch),
-    # one block at the end — the chip's prefill throughput
-    tile = min(eng.module.MAX_ATOM, prompt)
-    seqd = eng.state.schedule(30_000, tile)
+    # device rate: chained whole-prompt flash-prefill steps on
+    # device-resident inputs (async dispatch), one block at the end — the
+    # chip's prefill throughput
+    seqd = eng.state.schedule(30_000, prompt)
     bt_dev = jnp.asarray(eng._block_tables())
-    ids_dev = jnp.asarray(rng.integers(0, cfg.vocab_size, tile)
+    ids_dev = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, prompt))
                           .astype(np.int32))
-    slot_dev = jnp.full((tile,), seqd.slot, jnp.int32)
-    pos_dev = jnp.asarray(np.arange(tile, dtype=np.int32))
-    valid_dev = jnp.ones((tile,), bool)
-    gather_dev = jnp.zeros((max_seqs,), jnp.int32)
+    len_dev = jnp.asarray([prompt], np.int32)
+    slot_dev = jnp.asarray([seqd.slot], np.int32)
     cache = eng.cache
-    lg, cache = eng._step_packed(eng.params, ids_dev, cache, bt_dev, slot_dev,
-                                 pos_dev, valid_dev, gather_dev, 0,
-                                 tile)  # compile
+    lg, cache = eng._prefill_step(eng.params, ids_dev, len_dev, cache,
+                                  bt_dev, slot_dev)  # compile
     np.asarray(lg)
     reps = prefill_reps * 2
     t0 = time.perf_counter()
     for _ in range(reps):      # same slot re-prefilled: timing, not state
-        lg, cache = eng._step_packed(eng.params, ids_dev, cache, bt_dev,
-                                     slot_dev, pos_dev, valid_dev, gather_dev,
-                                     0, tile)
+        lg, cache = eng._prefill_step(eng.params, ids_dev, len_dev, cache,
+                                      bt_dev, slot_dev)
     np.asarray(lg)
-    prefill_dev_tps = reps * tile / (time.perf_counter() - t0)
+    prefill_dev_tps = reps * prompt / (time.perf_counter() - t0)
     eng.cache = cache
     eng.state.commit(30_000)
     eng.flush([30_000])

@@ -85,8 +85,9 @@ class InferenceEngineV2:
         if paged:
             self.num_blocks = self.state.allocator.num_blocks
             cache = model.init_paged_kv_cache(self.num_blocks, block_size)
-            # pool sharded over tp on the kv-head dim ([L, nb+1, bs, K, d])
-            kv_spec = shd.filter_spec(P(None, None, None, "tp", None),
+            # pool sharded over tp on the lane-folded kv-head dim
+            # ([L, nb+1, bs, K*d]: contiguous d-lanes per kv head)
+            kv_spec = shd.filter_spec(P(None, None, None, "tp"),
                                       self.mesh.axis_names)
             self.cache = jax.device_put(
                 cache, NamedSharding(self.mesh, kv_spec))
@@ -104,12 +105,15 @@ class InferenceEngineV2:
                                  out_shardings=(None, kv_out))
             self._step_packed = jax.jit(model.forward_with_packed_cache,
                                         donate_argnums=(2,),
-                                        static_argnums=(8, 9),
+                                        static_argnums=(8, 9, 10),
                                         out_shardings=(None, kv_out))
             self._decode_loop = jax.jit(self._multi_decode,
                                         donate_argnums=(1,),
                                         static_argnums=(6,),
                                         out_shardings=(None, kv_out))
+            self._prefill_step = jax.jit(self._prefill_impl,
+                                         donate_argnums=(3,),
+                                         out_shardings=(None, kv_out))
             log_dist(f"paged KV pool: {self.num_blocks} blocks x {block_size} "
                      f"tokens ({self.cache['k'].nbytes * 2 / 1e6:.0f} MB), "
                      f"mesh={self.topology}")
@@ -145,27 +149,50 @@ class InferenceEngineV2:
         """``steps`` greedy decode iterations fused into ONE device program
         (lax.scan): the TPU analog of the reference v1 engine's CUDA-graph
         replay (inference/engine.py:497) — per-step host dispatch and
-        transfers vanish, so decode throughput reflects the chip. ``valid``
-        masks bucket-padding rows (decode_batch pads B to powers of two so a
-        draining batch does not recompile the scan per occupancy)."""
+        transfers vanish, so decode throughput reflects the chip.
+
+        The paged pool stays READ-ONLY across the whole scan: per-step
+        appends would force XLA to snapshot-copy the pool at every Pallas
+        read (~2 ms x layers x steps). New KV accumulates in a dense tail
+        carry ([L, B, steps, K, d]) that attention treats as a third
+        flash-decode segment, and ONE scatter folds it into the pool after
+        the scan. ``valid`` masks bucket-padding rows (decode_batch pads B
+        to powers of two so a draining batch does not recompile the scan
+        per occupancy)."""
         import jax.numpy as jnp
 
+        from deepspeed_tpu.ops.paged_attention import packed_kv_append
+
+        cfg = self.cfg
         B = tok0.shape[0]
         if valid is None:
             valid = jnp.ones((B,), bool)
-        gather = jnp.arange(B, dtype=jnp.int32)
+        L, K, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        cdt = jnp.dtype(cfg.dtype)
+        tail0 = (jnp.zeros((L, B, steps, K, hd), cdt),
+                 jnp.zeros((L, B, steps, K, hd), cdt))
 
-        def step(carry, _):
-            cache, pos, toks = carry
-            logits, cache = self.module.forward_with_packed_cache(
-                params, toks, cache, bt, slots, pos, valid, gather,
-                decode_rows=B)
+        def step(carry, t):
+            tk, tv, toks = carry
+            logits, tail = self.module.forward_decode_tail(
+                params, toks, cache, {"k": tk, "v": tv}, t, bt, slots, pos0,
+                valid)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (cache, pos + 1, nxt), nxt
+            return (tail["k"], tail["v"], nxt), nxt
 
-        (cache, _, _), out = jax.lax.scan(step, (cache, pos0, tok0), None,
-                                          length=steps)
-        return out, cache                     # out: [steps, B]
+        (tk, tv, _), out = jax.lax.scan(
+            step, (*tail0, tok0), jnp.arange(steps, dtype=jnp.int32))
+        # fold the tail into the pool: one scatter per pool for the whole
+        # decode_batch call (row (b, s) -> slot[b] position pos0[b]+s)
+        rows_k = tk.reshape(L, B * steps, K, hd)
+        rows_v = tv.reshape(L, B * steps, K, hd)
+        slot2 = jnp.repeat(slots, steps)
+        pos2 = (pos0[:, None]
+                + jnp.arange(steps, dtype=pos0.dtype)[None, :]).reshape(-1)
+        valid2 = jnp.repeat(valid, steps)
+        nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
+        nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)
+        return out, {"k": nk, "v": nv}          # out: [steps, B]
 
     def decode_batch(self, batch_uids: Sequence[int],
                      batch_tokens: Sequence[int], steps: int
@@ -202,6 +229,63 @@ class InferenceEngineV2:
             self.state.commit(d.uid)
         return {d.uid: toks[:, i] for i, d in enumerate(descs)}
 
+    def _fresh(self, uid: int) -> bool:
+        seq = self.state.sequences.get(uid)
+        return seq is None or self._pos[seq.slot] == 0
+
+    def _prefill_impl(self, params, ids, lengths, cache, bt, slots):
+        """Whole-prompt prefill + one-scatter pool append (jitted, cache
+        donated — the model path never READS the pool, so the append stays
+        in place)."""
+        from deepspeed_tpu.ops.paged_attention import packed_kv_append
+
+        logits, kv = self.module.forward_prefill(params, ids, lengths)
+        L = kv["k"].shape[0]
+        Bp, T = ids.shape
+        K, hd = self.cfg.num_kv_heads, self.cfg.head_dim
+        rows_k = kv["k"].reshape(L, Bp * T, K * hd)
+        rows_v = kv["v"].reshape(L, Bp * T, K * hd)
+        slot2 = jnp.repeat(slots, T)
+        pos2 = jnp.tile(jnp.arange(T, dtype=jnp.int32), Bp)
+        valid2 = (jnp.arange(T)[None, :] < lengths[:, None]).reshape(-1)
+        nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
+        nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)
+        return logits, {"k": nk, "v": nv}
+
+    def _prefill_whole(self, batch_uids: Sequence[int], chunks
+                       ) -> Dict[int, np.ndarray]:
+        """Fresh whole prompts: flash-prefill every prompt in one step."""
+        if not self.state.can_schedule_batch(batch_uids,
+                                             [len(c) for c in chunks]):
+            raise RuntimeError(
+                f"cannot schedule uids={list(batch_uids)} "
+                f"(+{[len(c) for c in chunks]} tokens jointly)")
+        descs = [self.state.schedule(uid, len(c))
+                 for uid, c in zip(batch_uids, chunks)]
+        B = len(descs)
+        bpad = 1 << (B - 1).bit_length()
+        longest = max(len(c) for c in chunks)
+        T_pad = max(_MIN_TILE, 1 << (longest - 1).bit_length())
+        ids = np.zeros((bpad, T_pad), np.int32)
+        lengths = np.zeros((bpad,), np.int32)
+        slots = np.zeros((bpad,), np.int32)
+        for i, (d, c) in enumerate(zip(descs, chunks)):
+            ids[i, :len(c)] = c
+            lengths[i] = len(c)
+            slots[i] = d.slot
+        with jax.sharding.set_mesh(self.mesh):
+            logits, self.cache = self._prefill_step(
+                self.params, jnp.asarray(ids), jnp.asarray(lengths),
+                self.cache, jnp.asarray(self._block_tables()),
+                jnp.asarray(slots))
+            out = np.asarray(logits)
+        results: Dict[int, np.ndarray] = {}
+        for i, (d, c) in enumerate(zip(descs, chunks)):
+            results[d.uid] = out[i]
+            self._pos[d.slot] = d.seen_tokens + len(c)
+            self.state.commit(d.uid)
+        return results
+
     # ---- one continuous-batching step (engine_v2.py:107 parity) ----------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]
             ) -> Dict[int, np.ndarray]:
@@ -211,6 +295,10 @@ class InferenceEngineV2:
         ragged in effect while dense in shape."""
         assert len(batch_uids) == len(batch_tokens)
         chunks = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if self.packed and chunks and all(len(c) > 1 for c in chunks) \
+                and max(len(c) for c in chunks) <= self.module.PREFILL_MAX \
+                and all(self._fresh(uid) for uid in batch_uids):
+            return self._prefill_whole(batch_uids, chunks)
         if self.packed:
             # chunked prefill (FastGen scheduling behavior): prompts longer
             # than one atom are fed in MAX_ATOM slices over internal steps.
@@ -281,12 +369,15 @@ class InferenceEngineV2:
                 valid[off:off + len(c)] = True
                 gather_idx[i] = off + len(c) - 1
                 off += tile
+            # when every chunk atom starts at position 0 (fresh prefill) the
+            # past kernel is statically skipped — the common first-put case
+            no_past = all(d.seen_tokens == 0 for _, d, c in big)
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step_packed(
                     self.params, jnp.asarray(tok_ids), self.cache,
                     jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
                     jnp.asarray(tok_pos), jnp.asarray(valid),
-                    jnp.asarray(gather_idx), dr, tile)
+                    jnp.asarray(gather_idx), dr, tile, no_past)
                 out = np.asarray(logits)
             results: Dict[int, np.ndarray] = {}
             for i, (d, c) in enumerate(zip(descs, chunks)):

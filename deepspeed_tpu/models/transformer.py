@@ -826,13 +826,19 @@ class TransformerLM:
     def init_paged_kv_cache(self, num_blocks: int, block_size: int = 128,
                             dtype: Optional[Any] = None) -> Dict[str, jax.Array]:
         """Allocate the global blocked KV pool (inference v2 kv_cache.py parity):
-        ``[L, num_blocks+1, block_size, K, d]`` — the last block is scratch for
+        ``[L, num_blocks+1, block_size, K*d]`` — the last block is scratch for
         padded lanes. HBM is proportional to ``num_blocks``, not
-        ``max_sequences × max_seq_len``."""
+        ``max_sequences × max_seq_len``.
+
+        The (K, d) axes are stored LANE-FOLDED: a ``[.., K, d]`` layout pads
+        K up to the sublane tile, so "reshaping" it to ``[.., K*d]`` at the
+        kernel boundary is a full relayout copy of the pool — XLA re-issues
+        it at every Pallas read (measured ~1.8 ms x layers x steps on v5e).
+        Folding at allocation makes the kernels' DMA view the storage view."""
         cfg = self.cfg
         dt = jnp.dtype(dtype or cfg.dtype)
-        shape = (cfg.num_layers, num_blocks + 1, block_size, cfg.num_kv_heads,
-                 cfg.head_dim)
+        shape = (cfg.num_layers, num_blocks + 1, block_size,
+                 cfg.num_kv_heads * cfg.head_dim)
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     def forward_with_paged_cache(self, params: Params, input_ids: jax.Array,
@@ -861,6 +867,8 @@ class TransformerLM:
             x = x + params["embed"]["pos"][safe_pos].astype(dt)
         freqs = self._freqs
 
+        K, hd = cfg.num_kv_heads, cfg.head_dim
+
         def make_body(cseg):
             def body(carry, xs):
                 layer_w, kp, vp = xs
@@ -868,11 +876,16 @@ class TransformerLM:
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
                 new_kv = {}
+                # legacy escape-hatch path: unfold the lane-folded pool per
+                # layer (a relayout copy — the packed path avoids this)
+                kp4 = kp.reshape(kp.shape[0], kp.shape[1], K, hd)
+                vp4 = vp.reshape(vp.shape[0], vp.shape[1], K, hd)
 
                 def attn_cache_fn(q, k, v):
-                    nk = paged_update(kp, k, block_tables, pos, valid)
-                    nv = paged_update(vp, v, block_tables, pos, valid)
-                    new_kv["k"], new_kv["v"] = nk, nv
+                    nk = paged_update(kp4, k, block_tables, pos, valid)
+                    nv = paged_update(vp4, v, block_tables, pos, valid)
+                    new_kv["k"] = nk.reshape(kp.shape)
+                    new_kv["v"] = nv.reshape(vp.shape)
                     return paged_attention_tp(q, nk, nv, block_tables, pos,
                                               window=cseg.sliding_window)
 
@@ -905,7 +918,8 @@ class TransformerLM:
                                   valid: jax.Array,
                                   gather_idx: jax.Array,
                                   decode_rows: Optional[int] = None,
-                                  tile_tq: int = 128) -> Any:
+                                  tile_tq: int = 128,
+                                  tiles_no_past: bool = False) -> Any:
         """Token-packed continuous-batching step (ragged_wrapper.py parity).
 
         Unlike :meth:`forward_with_paged_cache`'s dense ``[max_sequences,
@@ -959,7 +973,7 @@ class TransformerLM:
 
         def make_body(cseg):
             def body(carry, xs):
-                layer_w, kp, vp = xs
+                layer_w, li = xs
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
@@ -968,17 +982,22 @@ class TransformerLM:
                 def attn_cache_fn(q, k, v):
                     q2, k2, v2 = q[:, 0], k[:, 0], v[:, 0]      # [N, H|K, d]
                     new_kv["k"], new_kv["v"] = k2, v2  # appended after scan
+                    # the WHOLE stacked pool rides through the scan closure
+                    # (ANY-memory operand, layer picked inside the kernel):
+                    # per-layer pool slices in the scan xs would materialize
+                    # a full pool copy every layer
                     parts = []
                     if dr:
                         parts.append(ragged_paged_attention_tp(
-                            q2[:dr], k2[:dr], v2[:dr], kp, vp, block_tables,
-                            a_slot_d, a_pos_d, a_len_d, tq=1,
-                            window=cseg.sliding_window))
+                            q2[:dr], k2[:dr], v2[:dr], cache["k"], cache["v"],
+                            block_tables, a_slot_d, a_pos_d, a_len_d, tq=1,
+                            window=cseg.sliding_window, layer=li))
                     if n_tiles:
                         parts.append(ragged_paged_attention_tp(
-                            q2[dr:], k2[dr:], v2[dr:], kp, vp, block_tables,
-                            a_slot_t, a_pos_t, a_len_t, tq=tile_tq,
-                            window=cseg.sliding_window))
+                            q2[dr:], k2[dr:], v2[dr:], cache["k"], cache["v"],
+                            block_tables, a_slot_t, a_pos_t, a_len_t,
+                            tq=tile_tq, window=cseg.sliding_window, layer=li,
+                            no_past=tiles_no_past))
                     out = (parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))
                     return out[:, None]                         # [N, 1, H, d]
@@ -994,7 +1013,7 @@ class TransformerLM:
         for lo, hi, cseg in self._window_segments():
             seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
                                              params["layers"]),
-                      cache["k"][lo:hi], cache["v"][lo:hi])
+                      jnp.arange(lo, hi, dtype=jnp.int32))
             x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_xs)
             kr_parts.append(kr)
             vr_parts.append(vr)
@@ -1007,6 +1026,195 @@ class TransformerLM:
         x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x[gather_idx] @ self._head(params).astype(dt)   # [G, V]
         return logits, {"k": nk, "v": nv}
+
+    PREFILL_MAX = 4096   # widest whole-prompt prefill (longer prompts chunk)
+
+    def forward_prefill(self, params: Params, input_ids: jax.Array,
+                        lengths: jax.Array) -> Any:
+        """Whole-prompt prefill at the training path's efficiency.
+
+        Fresh prompts (nothing cached) need no pool reads at all — their
+        attention is plain causal flash, exactly the training forward. This
+        runs the training-grade attention kernel over ``input_ids`` [B, T]
+        (right-padded; ``lengths`` [B] real lengths), stashes every layer's
+        K/V rows on the way (reference blocked_flash + kv_copy fusion,
+        inference/v2/model_implementations/flat_model_helpers.py), and
+        returns (last-token logits [B, V], kv {k,v: [L, B, T, K, d]}) for
+        the engine to fold into the paged pool with one scatter. Weights
+        stream once per PROMPT instead of once per 256-token chunk — on a
+        bandwidth-bound chip that alone is ~T/256 x.
+        """
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, T = input_ids.shape
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+        valid = positions < lengths[:, None]                    # [B, T]
+        x = params["embed"]["tokens"].astype(dt)[input_ids]
+        if cfg.learned_pos:
+            # T may be bucket-padded past max_seq_len; pad rows are never
+            # gathered or appended, so clamp like the packed path does
+            safe_pos = jnp.minimum(positions[0], cfg.max_seq_len - 1)
+            x = x + params["embed"]["pos"][safe_pos][None].astype(dt)
+        freqs = self._freqs
+        attn_fn = get_attention_impl(cfg.attention_impl)
+
+        def make_body(cseg):
+            def body(carry, layer_w):
+                wc = jax.tree_util.tree_map(
+                    lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+                    layer_w)
+                kv = {}
+
+                def attn_cache_fn(q, k, v):
+                    kv["k"], kv["v"] = k, v
+                    if cseg.sliding_window is not None:
+                        import inspect
+
+                        sig = inspect.signature(attn_fn).parameters
+                        takes_window = ("window" in sig or any(
+                            p.kind is inspect.Parameter.VAR_KEYWORD
+                            for p in sig.values()))
+                        if not takes_window:  # impls without native window
+                            return xla_attention(
+                                q, k, v, causal=True,
+                                window=cseg.sliding_window)
+                        return attn_fn(q, k, v, causal=True,
+                                       window=cseg.sliding_window)
+                    return attn_fn(q, k, v, causal=True)
+
+                h = _decode_block(carry, wc, cseg, freqs, positions,
+                                  attn_cache_fn, self.moe_fn,
+                                  moe_valid=valid)
+                return h, (kv["k"], kv["v"])
+
+            return body
+
+        kr_parts, vr_parts = [], []
+        for lo, hi, cseg in self._window_segments():
+            seg_layers = jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                                params["layers"])
+            x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_layers)
+            kr_parts.append(kr)
+            vr_parts.append(vr)
+        kr = kr_parts[0] if len(kr_parts) == 1 else jnp.concatenate(kr_parts)
+        vr = vr_parts[0] if len(vr_parts) == 1 else jnp.concatenate(vr_parts)
+        x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+        last = jnp.clip(lengths - 1, 0, T - 1)
+        xg = x[jnp.arange(B), last]                              # [B, D]
+        logits = xg @ self._head(params).astype(dt)
+        return logits, {"k": kr, "v": vr}
+
+    def forward_decode_tail(self, params: Params, toks: jax.Array,
+                            cache: Dict[str, jax.Array],
+                            tail: Dict[str, jax.Array], t: jax.Array,
+                            block_tables: jax.Array, slots: jax.Array,
+                            pos_base: jax.Array,
+                            valid: Optional[jax.Array] = None) -> Any:
+        """One fused-loop decode step with the pool READ-ONLY.
+
+        The engine's multi-step decode scan cannot scatter into the paged
+        pool every step: a Pallas read of a buffer that is also written
+        in-place inside the same loop makes XLA snapshot-copy the whole pool
+        per layer per step (measured ~2 ms x 16 x steps on v5e). Instead the
+        freshly decoded KV lives in a small dense ``tail``
+        ([L, B, steps, K, d], the in-flight tokens of this decode_batch
+        call) and the pool is folded once, after the scan
+        (``InferenceEngineV2._multi_decode``). Attention is a three-way
+        flash-decode split reduction: pool partials (work-list kernel over
+        positions < pos_base) ⊕ tail+self (dense XLA over cols <= t).
+
+        ``toks`` [B]; ``t`` traced step index; ``pos_base`` [B] pool
+        frontier (tokens already in the pool); row position = pos_base + t.
+        Returns (logits [B, V], updated tail).
+        """
+        from deepspeed_tpu.ops.paged_attention import decode_pool_partials_tp
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B = toks.shape[0]
+        K = cfg.num_kv_heads
+        hd = cfg.head_dim
+        rep = cfg.num_heads // K
+        S_tail = tail["k"].shape[2]
+        if valid is None:
+            valid = jnp.ones((B,), bool)
+        row_pos = pos_base + t                                   # [B]
+        positions = row_pos[:, None]
+        x = params["embed"]["tokens"].astype(dt)[toks][:, None, :]
+        if cfg.learned_pos:
+            safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+            x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        freqs = self._freqs
+        scale = 1.0 / math.sqrt(hd)
+
+        def make_body(cseg):
+            def body(carry, xs):
+                h, tk, tv = carry
+                layer_w, li = xs
+                wc = jax.tree_util.tree_map(
+                    lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
+                    layer_w)
+                box = {}
+
+                def attn_cache_fn(q, k, v):
+                    q2, k2, v2 = q[:, 0], k[:, 0], v[:, 0]    # [B, H|K, d]
+                    window = cseg.sliding_window
+                    acc, m_k, l_k = decode_pool_partials_tp(
+                        q2, cache["k"], cache["v"], li, block_tables, slots,
+                        pos_base, window=window, row_pos=row_pos)
+                    # append self into the tail, then attend tail cols <= t
+                    tk2 = jax.lax.dynamic_update_slice(
+                        tk, k2[None, :, None].astype(tk.dtype),
+                        (li, 0, t, 0, 0))
+                    tv2 = jax.lax.dynamic_update_slice(
+                        tv, v2[None, :, None].astype(tv.dtype),
+                        (li, 0, t, 0, 0))
+                    box["tk"], box["tv"] = tk2, tv2
+                    tkl = jax.lax.dynamic_index_in_dim(tk2, li, keepdims=False)
+                    tvl = jax.lax.dynamic_index_in_dim(tv2, li, keepdims=False)
+                    qg = q2.reshape(B, K, rep, hd).astype(jnp.float32)
+                    s_t = jnp.einsum("bkrd,bskd->bkrs", qg,
+                                     tkl.astype(jnp.float32)) * scale
+                    col = jnp.arange(S_tail)[None, None, None, :]
+                    keep = col <= t
+                    if window is not None:
+                        keep = keep & (col > t - window)
+                    s_t = jnp.where(keep, s_t, -1e30)
+                    m_t = jnp.max(s_t, axis=-1)                # [B, K, rep]
+                    p_t = jnp.where(keep, jnp.exp(s_t - m_t[..., None]), 0.0)
+                    l_t = jnp.sum(p_t, axis=-1)
+                    acc_t = jnp.einsum("bkrs,bskd->bkrd", p_t,
+                                       tvl.astype(jnp.float32))
+                    H = K * rep
+                    m_t = m_t.reshape(B, H)
+                    l_t = l_t.reshape(B, H)
+                    acc_t = acc_t.reshape(B, H, hd)
+                    m2 = jnp.maximum(m_k, m_t)
+                    c_k = jnp.exp(m_k - m2)
+                    c_t = jnp.exp(m_t - m2)
+                    denom = jnp.maximum(l_k * c_k + l_t * c_t, 1e-30)
+                    out = ((acc * c_k[..., None] + acc_t * c_t[..., None])
+                           / denom[..., None])
+                    out = jnp.where(valid[:, None, None], out, 0)
+                    return out.astype(q.dtype)[:, None]        # [B, 1, H, d]
+
+                h = _decode_block(h, wc, cseg, freqs, positions,
+                                  attn_cache_fn, self.moe_fn,
+                                  moe_valid=valid[:, None])
+                return (h, box["tk"], box["tv"]), None
+
+            return body
+
+        tk, tv = tail["k"], tail["v"]
+        for lo, hi, cseg in self._window_segments():
+            seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                             params["layers"]),
+                      jnp.arange(lo, hi, dtype=jnp.int32))
+            (x, tk, tv), _ = jax.lax.scan(make_body(cseg), (x, tk, tv),
+                                          seg_xs)
+        x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
+        logits = x @ self._head(params).astype(dt)               # [B, V]
+        return logits, {"k": tk, "v": tv}
 
     # ---- sharding ---------------------------------------------------------
     def param_specs(self) -> Params:

@@ -241,155 +241,594 @@ def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
 # ---------------------------------------------------------------------------
 # Ragged atom kernels (FastGen atom_builder/blocked_flash parity, decode-fast)
 #
-# The grid-per-(row, head, block) kernel above re-fetches each KV block once
-# per query row — O(T^2/bs) HBM traffic for prefill chunks — and pays a full
-# pool transpose plus a per-layer pool copy (the scan cannot alias the
-# scatter) per step. The kernels below are the serving-throughput path:
+# The serving-throughput path. An atom is one whole scheduled chunk (decode
+# step = 1-token atom, prefill chunk = up to MAX_ATOM tokens). Two kernels,
+# each shaped for its region's bottleneck:
 #
-# * atom = one whole scheduled chunk (decode step = 1-token atom, prefill
-#   chunk = up to MAX_ATOM tokens; longer prompts are chunked across put()s);
-# * ONE grid step per atom: all heads computed inside the step, past-put KV
-#   blocks streamed from the raw pool layout by double-buffered manual DMA
-#   (each block fetched once per atom), and the atom attends its OWN tokens
-#   straight from VMEM — so the current step's pool writes are NOT needed by
-#   its attention, and the model hoists all layers' KV appends into one
-#   in-place scatter after the layer scan (free under buffer donation);
-# * the (K, d) axes are folded to K*d lanes at the kernel boundary: every
-#   DMA chunk is a [bs, K*d] tile — sub-tile row DMAs crash the Mosaic
-#   toolchain and tiny-sublane chunks are slow.
+# * DECODE (tq == 1): HBM-latency-bound — per-atom serial block streaming
+#   leaves the memory system idle between tiny DMAs (measured ~10 ms flat in
+#   occupancy on v5e, ~10x off the KV-bandwidth roofline). The kernel below
+#   runs a flat WORK LIST of (atom, block-group) items: each item issues
+#   ``_DECODE_G`` per-block async copies concurrently (blocks are table-
+#   indirected, so no single large DMA is possible — the win is G copies in
+#   flight per item) and the pipeline keeps ``_DMA_DEPTH`` item-fetches in
+#   flight ACROSS atoms, so transfers never serialize behind compute.
+#   All GQA heads are computed in ONE MXU matmul per item via a zero-padded
+#   [H, K*d] query ("q_big": head h occupies lane block h//rep, zeros
+#   elsewhere — the K-fold FLOPs waste is ~free, decode is bandwidth-bound).
+#   The atom's own token is merged OUTSIDE the kernel from the returned
+#   (acc, m, l) partials — flash-decode's split-reduction, with the self
+#   token as the extra partial.
+# * PREFILL (tq > 1): split reduction. A work-list kernel (same machinery
+#   as decode, per-kv-head [R=tq*rep, G*bs] tiles) streams the PAST blocks
+#   into (acc, m, l) partials; a REAL flash tile (same structure as the
+#   training kernel in ops/flash_attention.py) runs the intra-atom causal
+#   attention with its online-softmax scratch SEEDED from those partials —
+#   so chunked prefill hits training-class efficiency and the merge costs
+#   one scratch init instead of an XLA pass.
+#
+# Both kernels read the pools STACKED across layers ([L, nbp1, bs, K, d] in
+# ANY/HBM memory, a traced layer index picks the layer) — threading
+# per-layer pool slices through the model's lax.scan would materialize a
+# full pool copy per layer (measured ~12 ms/step of pure copies on v5e).
+# The (K, d) axes are folded to K*d lanes at the kernel boundary: every DMA
+# chunk is a [bs, K*d] tile — sub-tile row DMAs crash the Mosaic toolchain
+# and tiny-sublane chunks are slow.
 # ---------------------------------------------------------------------------
 
 # (the atom-width cap lives on TransformerLM.MAX_ATOM — the engine chunking
 # and the VMEM-bounded kernel tile share that single constant)
 
+_DECODE_G = 4       # KV blocks per decode work item (one DMA pair per item)
+_PAST_G = 2         # KV blocks per prefill-past work item (bigger per-block
+                    # compute; smaller groups keep VMEM under the 16MB cap)
+_DMA_DEPTH = 2      # work-item fetches kept in flight across the work list
 
-def _ragged_kernel(slot_ref, pos0_ref, len_ref, bt_ref, q_ref, ks_ref, vs_ref,
-                   kpool, vpool, o_ref, kbuf, vbuf, dsem, m_scr, l_scr,
-                   acc_scr, *, scale: float, bs: int, tq: int, K: int,
-                   rep: int, nb_max: int, window):
-    a = pl.program_id(0)
-    pos0 = pos0_ref[a]
-    alen = len_ref[a]
-    slot = slot_ref[a]
-    R = tq * rep
-    d = q_ref.shape[-1]
 
-    @pl.when(alen > 0)
-    def _atom():
-        q = q_ref[:].reshape(tq, K, rep, d)
+def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
+                      bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem):
+    """Shared work-list DMA machinery: item j = G consecutive logical KV
+    blocks of atom j//NG, streamed from the STACKED pool layer li."""
+
+    def item_dmas(j, dst):
+        jc = jnp.clip(j, 0, n_items - 1)
+        aj = jc // NG
+        gj = jax.lax.rem(jc, NG)
+        slot = slot_ref[aj]
+        li = li_ref[0]
+        copies = []
+        for gg in range(G):
+            lb = jnp.clip(lo_ref[aj] + gj * G + gg, 0, nb_max - 1)
+            bid = bt_ref[slot, lb]
+            copies.append(pltpu.make_async_copy(
+                kpool.at[li, bid], kbuf.at[dst, pl.ds(gg * bs, bs)],
+                dsem.at[dst, 0, gg]))
+            copies.append(pltpu.make_async_copy(
+                vpool.at[li, bid], vbuf.at[dst, pl.ds(gg * bs, bs)],
+                dsem.at[dst, 1, gg]))
+        return copies
+
+    def item_active(j):
+        jc = jnp.clip(j, 0, n_items - 1)
+        return (j < n_items) & (jax.lax.rem(jc, NG) < ng_ref[jc // NG])
+
+    return item_dmas, item_active
+
+
+def _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window):
+    """(lo block, group count >= 1) of each atom's visible past range.
+    ``row_pos`` (>= pos0) is the query row's global position — it trails the
+    sliding window; ``pos0`` is the pool frontier (tokens < pos0 cached)."""
+    pos0 = atom_pos0.astype(jnp.int32)
+    if window is not None:
+        lo = jnp.maximum((row_pos.astype(jnp.int32) - (window - 1)) // bs, 0)
+    else:
+        lo = jnp.zeros_like(pos0)
+    nblk = jnp.where(
+        pos0 > 0,
+        jnp.maximum(jnp.minimum((pos0 - 1) // bs, nb_max - 1) - lo + 1, 0), 0)
+    ng = jnp.maximum(-(-nblk // G), 1).astype(jnp.int32)
+    return pos0, lo.astype(jnp.int32), ng
+
+
+def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
+                   bt_ref, q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
+                   kbuf, vbuf, dsem, m_scr, l_scr, acc_scr, *,
+                   scale: float, bs: int, K: int, rep: int, nb_max: int,
+                   NG: int, window):
+    """One work item = G consecutive past-KV blocks of one decode atom."""
+    i = pl.program_id(0)
+    n_items = pl.num_programs(0)
+    G, DEPTH = _DECODE_G, _DMA_DEPTH
+    H = q_ref.shape[1]
+    d = kpool.shape[-1] // K
+    a = i // NG
+    g = jax.lax.rem(i, NG)
+    item_dmas, item_active = _worklist_helpers(
+        n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
+        kpool, vpool, kbuf, vbuf, dsem)
+
+    @pl.when(i == 0)
+    def _warmup():
+        for joff in range(DEPTH):
+            @pl.when(item_active(joff))
+            def _issue(_j=joff):
+                for c in item_dmas(_j, _j % DEPTH):
+                    c.start()
+
+    @pl.when(g == 0)
+    def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        # ---- intra-atom causal attention from VMEM (the atom's own KV) ----
-        if tq == 1:
-            # decode atom: the only intra token is the row itself — Mosaic
-            # cannot lower N=1 matmuls, so use elementwise forms
-            for kk in range(K):
-                qk = q[:, kk].reshape(R, d)
-                ks_row = ks_ref[0, :, kk * d:(kk + 1) * d].astype(jnp.float32)
-                s = jnp.sum(qk.astype(jnp.float32) * ks_row, axis=1,
-                            keepdims=True) * scale               # [R, 1]
-                m_scr[kk] = jnp.broadcast_to(s, m_scr.shape[1:])
-                l_scr[kk] = jnp.ones_like(l_scr[kk])
-                acc_scr[kk] = jnp.broadcast_to(
-                    vs_ref[0, :, kk * d:(kk + 1) * d].astype(jnp.float32),
-                    acc_scr.shape[1:])
+    active = g < ng_ref[a]
+
+    @pl.when(active)
+    def _compute():
+        dst = jax.lax.rem(i, DEPTH)
+        for c in item_dmas(i, dst):
+            c.wait()
+        kb = kbuf[dst]                           # [G*bs, K*d]
+        vb = vbuf[dst]
+        qb = q_ref[0]                            # [H, K*d] zero-padded
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos0 = pos0_ref[a]
+        colpos = ((lo_ref[a] + g * G) * bs
+                  + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        keep = colpos < pos0
+        if window is not None:
+            keep = keep & (colpos > row_ref[a] - window)
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        ob = jax.lax.dot_general(p.astype(vb.dtype), vb,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # head-select the GQA group's lane block out of [H, K*d]
+        obh = ob.reshape(H, K, d)
+        sel = (jax.lax.broadcasted_iota(jnp.int32, (H, K, 1), 1)
+               == jax.lax.broadcasted_iota(jnp.int32, (H, K, 1), 0) // rep)
+        acc_scr[:] = acc_scr[:] * corr + jnp.sum(
+            jnp.where(sel, obh, 0.0), axis=1)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    # refill the pipeline AFTER the compute consumed this slot's buffers —
+    # item i+DEPTH reuses slot i%DEPTH. Outside the `active` guard: an
+    # inactive item must still issue its successor or a gap in the work
+    # list would starve the pipeline.
+    @pl.when(item_active(i + DEPTH))
+    def _prefetch():
+        for c in item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)):
+            c.start()
+
+    @pl.when(g == ng_ref[a] - 1)
+    def _finalize():
+        acc_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
+                         atom_pos0, *, window=None, row_pos=None,
+                         interpret=None):
+    """(acc, m, l) flash-decode partials of each decode row's attention over
+    its POOL-cached past (positions < pos0). ``row_pos`` is the query's true
+    position (defaults to pos0) — it only matters for sliding windows, e.g.
+    in the fused loop where rows advance while the pool frontier stays put.
+    q [A, H, d]; pools STACKED lane-folded [L, nbp1, bs, K*d]. Returns fp32
+    acc [A, H, d] (unnormalized), m/l [A, H]."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    A, H, d = q.shape
+    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    rep = H // K
+    nb_max = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    if row_pos is None:
+        row_pos = atom_pos0
+    if not interpret and (d % 128 or bs % 8):
+        return xla_decode_partials(q, k_pool, v_pool, layer, block_tables,
+                                   atom_slot, atom_pos0, window=window,
+                                   row_pos=row_pos)
+    G = _DECODE_G
+    NG = max(1, -(-nb_max // G))
+    pos0, lo, ng = _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window)
+
+    # zero-padded q_big: head h lives in lane block h//rep
+    hsel = (jnp.arange(K)[None, :] == (jnp.arange(H) // rep)[:, None])
+    q_big = jnp.where(hsel[None, :, :, None], q[:, :, None, :], 0)
+    q_big = q_big.reshape(A, H, K * d).astype(k_pool.dtype)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, bs=bs, K=K, rep=rep, nb_max=nb_max,
+        NG=NG, window=window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(A * NG,),
+        in_specs=[
+            pl.BlockSpec((1, H, K * d), lambda i, *_: (i // NG, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, d), lambda i, *_: (i // NG, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda i, *_: (i // NG, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda i, *_: (i // NG, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
+            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((_DMA_DEPTH, 2, G)),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, d), jnp.float32),
+        ],
+    )
+    acc, m_p, l_p = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((A, H, d), jnp.float32),
+            jax.ShapeDtypeStruct((A, H, 128), jnp.float32),
+            jax.ShapeDtypeStruct((A, H, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32), pos0,
+      row_pos.astype(jnp.int32), lo, ng, block_tables.astype(jnp.int32),
+      q_big, k_pool, v_pool)
+    return acc, m_p[..., 0], l_p[..., 0]
+
+
+def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
+                        atom_pos0, *, window=None, row_pos=None):
+    """Dense-gather reference/fallback for :func:`decode_pool_partials`
+    (pools stacked lane-folded [L, nbp1, bs, K*d])."""
+    A, H, d = q.shape
+    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    rep = H // K
+    if row_pos is None:
+        row_pos = atom_pos0
+    kp = jax.lax.dynamic_index_in_dim(k_pool, layer, keepdims=False)
+    vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
+    bt = block_tables[atom_slot]                            # [A, nb_max]
+    S = bt.shape[1] * bs
+    kd = kp[bt].reshape(A, S, K, d)
+    vd = vp[bt].reshape(A, S, K, d)
+    if K != H:
+        kd = jnp.repeat(kd, rep, axis=2)
+        vd = jnp.repeat(vd, rep, axis=2)
+    s = jnp.einsum("ahd,ashd->ahs", q.astype(jnp.float32),
+                   kd.astype(jnp.float32)) / math.sqrt(d)
+    col = jnp.arange(S)[None, None, :]
+    keep = col < atom_pos0[:, None, None]
+    if window is not None:
+        keep = keep & (col > row_pos[:, None, None] - window)
+    s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                 # [A, H]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(keep, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("ahs,ashd->ahd", p, vd.astype(jnp.float32))
+    return acc, m, l
+
+
+def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
+                            atom_slot, atom_pos0, axis: str = "tp",
+                            window=None, row_pos=None):
+    """Tensor-parallel :func:`decode_pool_partials` (heads embarrassingly
+    parallel: q on H, pools on K, partials out on H)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        return decode_pool_partials(q, k_pool, v_pool, layer, block_tables,
+                                    atom_slot, atom_pos0, window=window,
+                                    row_pos=row_pos)
+    if row_pos is None:
+        row_pos = atom_pos0
+
+    def shard_fn(q, kp, vp, lay, bt, a_s, a_p, rp):
+        return decode_pool_partials(q, kp, vp, lay, bt, a_s, a_p,
+                                    window=window, row_pos=rp)
+
+    return jax.shard_map(
+        shard_fn,
+        in_specs=(P(None, axis, None), P(None, None, None, axis),
+                  P(None, None, None, axis), P(), P(None, None),
+                  P(None), P(None), P(None)),
+        out_specs=(P(None, axis, None), P(None, axis), P(None, axis)),
+        check_vma=False,
+    )(q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0, row_pos)
+
+
+def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
+                      atom_slot, atom_pos0, atom_len, *, window, interpret):
+    """Decode-row attention: pool partials + self token merged outside
+    (flash-decode split reduction). Shapes: q/k_self/v_self [A, H|K, d];
+    pools STACKED lane-folded [L, nbp1, bs, K*d], ``layer`` picks the
+    layer."""
+    A, H, d = q.shape
+    K = k_self.shape[-2]
+    rep = H // K
+    scale = 1.0 / math.sqrt(d)
+    acc, m_k, l_k = decode_pool_partials(
+        q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0,
+        window=window, interpret=interpret)
+
+    # merge the self token (its position == pos0: always causal-visible and
+    # inside any window)
+    qf = q.astype(jnp.float32)
+    ks = jnp.repeat(k_self.astype(jnp.float32), rep, axis=1)    # [A, H, d]
+    vs = jnp.repeat(v_self.astype(jnp.float32), rep, axis=1)
+    s_self = jnp.sum(qf * ks, axis=-1) * scale                  # [A, H]
+    m2 = jnp.maximum(m_k, s_self)
+    c_k = jnp.exp(m_k - m2)
+    c_s = jnp.exp(s_self - m2)
+    denom = jnp.maximum(l_k * c_k + c_s, 1e-30)
+    out = (acc * c_k[..., None] + vs * c_s[..., None]) / denom[..., None]
+    out = jnp.where(atom_len[:, None, None] > 0, out, 0)
+    return out.astype(q.dtype)
+
+
+def _past_kernel(li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
+                 kpool, vpool, acc_ref, m_ref, l_ref,
+                 kbuf, vbuf, dsem, m_scr, l_scr, acc_scr, *,
+                 scale: float, bs: int, tq: int, K: int, rep: int,
+                 nb_max: int, NG: int, window):
+    """Prefill-past partials: one work item = G past blocks of one chunk
+    atom, per-kv-head score/update loops over [R=tq*rep, G*bs] tiles."""
+    i = pl.program_id(0)
+    n_items = pl.num_programs(0)
+    G, DEPTH = _PAST_G, _DMA_DEPTH
+    d = kpool.shape[-1] // K
+    R = tq * rep
+    a = i // NG
+    g = jax.lax.rem(i, NG)
+    item_dmas, item_active = _worklist_helpers(
+        n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
+        kpool, vpool, kbuf, vbuf, dsem)
+
+    @pl.when(i == 0)
+    def _warmup():
+        for joff in range(DEPTH):
+            @pl.when(item_active(joff))
+            def _issue(_j=joff):
+                for c in item_dmas(_j, _j % DEPTH):
+                    c.start()
+
+    @pl.when(g == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    active = g < ng_ref[a]
+
+    @pl.when(active)
+    def _compute():
+        dst = jax.lax.rem(i, DEPTH)
+        for c in item_dmas(i, dst):
+            c.wait()
+        pos0 = pos0_ref[a]
+        colpos = ((lo_ref[a] + g * G) * bs
+                  + jax.lax.broadcasted_iota(jnp.int32, (R, G * bs), 1))
+        keep = colpos < pos0
+        if window is not None:
+            rowpos = (pos0 + jax.lax.broadcasted_iota(
+                jnp.int32, (R, G * bs), 0) // rep)
+            keep = keep & (colpos > rowpos - window)
+        for kk in range(K):
+            qk = q_ref[0, kk]                    # [R, d]
+            s = jax.lax.dot_general(
+                qk, kbuf[dst, :, kk * d:(kk + 1) * d],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [R, G*bs]
+            s = jnp.where(keep, s, NEG_INF)
+            m_prev = m_scr[kk, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[kk] = jnp.broadcast_to(
+                l_scr[kk, :, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+                l_scr.shape[1:])
+            acc_scr[kk] = acc_scr[kk] * corr + jax.lax.dot_general(
+                p.astype(vbuf.dtype), vbuf[dst, :, kk * d:(kk + 1) * d],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+
+    @pl.when(item_active(i + DEPTH))
+    def _prefetch():
+        for c in item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)):
+            c.start()
+
+    @pl.when(g == ng_ref[a] - 1)
+    def _finalize():
+        acc_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _self_kernel(len_ref, q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref, o_ref,
+                 m_scr, l_scr, acc_scr, *, scale: float, block_q: int,
+                 block_k: int, window, has_past: bool):
+    """Intra-atom causal flash over the chunk's own (right-padded) tokens,
+    optionally seeded from the past kernel's partials — the second half of
+    the flash-decode split reduction, fused into the flash epilogue."""
+    a, iq, ik = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    alen = len_ref[a]
+
+    @pl.when(ik == 0)
+    def _init():
+        if has_past:
+            m_scr[:] = m0_ref[0, 0]
+            l_scr[:] = l0_ref[0, 0]
+            acc_scr[:] = a0_ref[0, 0]
         else:
-            row_tok = jax.lax.broadcasted_iota(jnp.int32, (R, tq), 0) // rep
-            col_tok = jax.lax.broadcasted_iota(jnp.int32, (R, tq), 1)
-            keep_i = (col_tok <= row_tok) & (col_tok < alen) & (row_tok < alen)
-            if window is not None:
-                keep_i = keep_i & (col_tok > row_tok - window)
-            for kk in range(K):
-                qk = q[:, kk].reshape(R, d)
-                s = jax.lax.dot_general(
-                    qk, ks_ref[0, :, kk * d:(kk + 1) * d],
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale  # [R, tq]
-                s = jnp.where(keep_i, s, NEG_INF)
-                m_new = jnp.max(s, 1, keepdims=True)
-                p = jnp.exp(s - m_new)
-                l_scr[kk] = jnp.broadcast_to(
-                    jnp.sum(p, 1, keepdims=True), l_scr.shape[1:])
-                acc_scr[kk] = jax.lax.dot_general(
-                    p.astype(vs_ref.dtype), vs_ref[0, :, kk * d:(kk + 1) * d],
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        # ---- past blocks (previous put()s) streamed from the pool ---------
-        @pl.when(pos0 > 0)
-        def _past():
-            hi = jnp.minimum((pos0 - 1) // bs, nb_max - 1)
-            lo = jnp.int32(0)
-            if window is not None:
-                lo = jnp.maximum((pos0 - (window - 1)) // bs, 0)
+    live = jnp.logical_and(ik * block_k <= iq * block_q + block_q - 1,
+                           ik * block_k < alen)
+    if window is not None:
+        live = jnp.logical_and(
+            live, ik * block_k + block_k - 1 >= iq * block_q - (window - 1))
 
-            def dma(i, buf):
-                bid = bt_ref[slot, jnp.clip(i, 0, nb_max - 1)]
-                return (pltpu.make_async_copy(kpool.at[bid], kbuf.at[buf],
-                                              dsem.at[buf, 0]),
-                        pltpu.make_async_copy(vpool.at[bid], vbuf.at[buf],
-                                              dsem.at[buf, 1]))
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        keep = (col <= row) & (col < alen)
+        if window is not None:
+            keep = keep & (col > row - window)
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-            for c in dma(lo, 0):
-                c.start()
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        out = acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+        row_ok = (iq * block_q
+                  + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+                  < alen)
+        o_ref[0, 0] = jnp.where(row_ok, out, 0).astype(o_ref.dtype)
 
-            def body(i, _):
-                buf = jax.lax.rem(i - lo, 2)
 
-                @pl.when(i < hi)
-                def _prefetch():
-                    for c in dma(i + 1, 1 - buf):
-                        c.start()
+def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
+                       block_tables, atom_slot, atom_pos0, atom_len, tq, *,
+                       window, interpret, no_past=False):
+    """Chunk-atom attention = past work-list partials + seeded self flash.
+    Pools stacked lane-folded [L, nbp1, bs, K*d]."""
+    N, H, d = q.shape
+    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    rep = H // K
+    A = N // tq
+    R = tq * rep
+    nb_max = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(d)
 
-                for c in dma(i, buf):  # waits recover the in-flight copy
-                    c.wait()
-                row_pos = pos0 + jax.lax.broadcasted_iota(
-                    jnp.int32, (R, bs), 0) // rep
-                col_pos = i * bs + jax.lax.broadcasted_iota(
-                    jnp.int32, (R, bs), 1)
-                keep = (col_pos < pos0) &                     (jax.lax.broadcasted_iota(jnp.int32, (R, bs), 0) // rep
-                     < alen)
-                if window is not None:
-                    keep = keep & (col_pos > row_pos - window)
-                for kk in range(K):
-                    qk = q[:, kk].reshape(R, d)
-                    s = jax.lax.dot_general(
-                        qk, kbuf[buf, :, kk * d:(kk + 1) * d],
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale  # [R, bs]
-                    s = jnp.where(keep, s, NEG_INF)
-                    m_prev = m_scr[kk, :, :1]
-                    m_new = jnp.maximum(m_prev, jnp.max(s, 1, keepdims=True))
-                    p = jnp.exp(s - m_new)
-                    corr = jnp.exp(m_prev - m_new)
-                    l_scr[kk] = jnp.broadcast_to(
-                        l_scr[kk, :, :1] * corr
-                        + jnp.sum(p, 1, keepdims=True), l_scr.shape[1:])
-                    acc_scr[kk] = acc_scr[kk] * corr + jax.lax.dot_general(
-                        p.astype(vbuf.dtype),
-                        vbuf[buf, :, kk * d:(kk + 1) * d],
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                    m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
-                return 0
+    if not no_past:
+        G = _PAST_G
+        NG = max(1, -(-nb_max // G))
+        # the OLDEST query row (position pos0) governs the window's lo block
+        pos0, lo, ng = _past_ranges(atom_pos0, atom_pos0, bs, nb_max, G,
+                                    window)
+        # q in per-kv-head row blocks: [A, K, R=tq*rep, d], row r=(t, rr)
+        qk = (q.reshape(A, tq, K, rep, d).transpose(0, 2, 1, 3, 4)
+              .reshape(A, K, R, d))
+        kernel = functools.partial(
+            _past_kernel, scale=scale, bs=bs, tq=tq, K=K, rep=rep,
+            nb_max=nb_max, NG=NG, window=window)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(A * NG,),
+            in_specs=[
+                pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
+                pl.BlockSpec((1, K, R, 128), lambda i, *_: (i // NG, 0, 0, 0)),
+                pl.BlockSpec((1, K, R, 128), lambda i, *_: (i // NG, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
+                pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+                pltpu.SemaphoreType.DMA((_DMA_DEPTH, 2, G)),
+                pltpu.VMEM((K, R, 128), jnp.float32),
+                pltpu.VMEM((K, R, 128), jnp.float32),
+                pltpu.VMEM((K, R, d), jnp.float32),
+            ],
+        )
+        acc_p, m_p, l_p = pl.pallas_call(
+            kernel, grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((A, K, R, d), jnp.float32),
+                jax.ShapeDtypeStruct((A, K, R, 128), jnp.float32),
+                jax.ShapeDtypeStruct((A, K, R, 128), jnp.float32),
+            ],
+            interpret=interpret,
+        )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32),
+          pos0, lo, ng, block_tables.astype(jnp.int32), qk, k_pool, v_pool)
 
-            jax.lax.fori_loop(lo, hi + 1, body, 0)
+        def to_hq(x):  # [A, K, (tq, rep), c] -> [A, H=K*rep, tq, c]
+            c = x.shape[-1]
+            return (x.reshape(A, K, tq, rep, c).transpose(0, 1, 3, 2, 4)
+                    .reshape(A, H, tq, c))
+        m0, l0, a0 = to_hq(m_p), to_hq(l_p), to_hq(acc_p)
+    else:
+        # dummy inits of the right block shape (the kernel ignores them)
+        m0 = l0 = jnp.zeros((A, H, tq, 128), jnp.float32)
+        a0 = jnp.zeros((A, H, tq, d), jnp.float32)
 
-        out = acc_scr[:] / jnp.maximum(l_scr[:, :, :1], 1e-30)  # [K, R, d]
-        out = (out.reshape(K, tq, rep, d)
-               .transpose(1, 0, 2, 3)
-               .reshape(tq, K * rep, d))
-        # rows past alen saw only NEG_INF scores (exp(-inf - -inf) = 1):
-        # zero them like the reference (they are padding, never gathered)
-        row_ok = jax.lax.broadcasted_iota(jnp.int32, (tq, 1, 1), 0) < alen
-        o_ref[:] = jnp.where(row_ok, out, 0).astype(o_ref.dtype)
+    bk = 128 if not interpret else bs
+    bq = tq
+    while bq > 256 or tq % bq:
+        bq //= 2
+    tq_pad = -(-tq // bk) * bk
+    pad = [(0, 0), (0, tq_pad - tq), (0, 0), (0, 0)]
+    ks4 = (jnp.pad(k_self.reshape(A, tq, K, d), pad).astype(k_pool.dtype)
+           .transpose(0, 2, 1, 3))
+    vs4 = (jnp.pad(v_self.reshape(A, tq, K, d), pad).astype(v_pool.dtype)
+           .transpose(0, 2, 1, 3))
+    q4 = q.reshape(A, tq, H, d).transpose(0, 2, 1, 3)
 
-    @pl.when(alen <= 0)
-    def _pad_atom():
-        o_ref[:] = jnp.zeros_like(o_ref)
+    kernel = functools.partial(
+        _self_kernel, scale=scale, block_q=bq, block_k=bk, window=window,
+        has_past=not no_past)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(A, H, tq // bq, tq_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda a, h, iq, ik, *_: (a, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda a, h, iq, ik, *_: (a, h // rep, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda a, h, iq, ik, *_: (a, h // rep, ik, 0)),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda a, h, iq, ik, *_: (a, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda a, h, iq, ik, *_: (a, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda a, h, iq, ik, *_: (a, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda a, h, iq, ik, *_: (a, h, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((A, H, tq, d), q.dtype),
+        interpret=interpret,
+    )(atom_len.astype(jnp.int32), q4, ks4, vs4, m0, l0, a0)
+    return out.transpose(0, 2, 1, 3).reshape(N, H, d)
 
 
 def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
@@ -397,64 +836,60 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
                            block_tables: jax.Array, atom_slot: jax.Array,
                            atom_pos0: jax.Array, atom_len: jax.Array,
                            tq: int, window: Optional[int] = None,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           layer: Optional[jax.Array] = None,
+                           no_past: bool = False) -> jax.Array:
     """Attention over atoms of the packed token row.
 
     ``q``/``k_self``/``v_self``: [N, H|K, d] with N = n_atoms*tq; atom ``a``
     covers rows [a*tq, a*tq+atom_len[a]) — consecutive positions
     ``atom_pos0[a]+i`` of sequence slot ``atom_slot[a]``. The atom's own KV
-    (``k_self``/``v_self``) is read from VMEM, so the pools only need tokens
-    of PREVIOUS put()s (positions < atom_pos0) — the current step's appends
-    happen after the fact, in one hoisted scatter. Each past KV block is
-    DMA'd once per atom in the raw (lane-folded) pool layout, double-
-    buffered against the score/softmax compute. Returns [N, H, d]."""
+    (``k_self``/``v_self``) never goes through the pools — the pools only
+    need tokens of PREVIOUS put()s (positions < atom_pos0), so the step's
+    appends happen after the fact, in one hoisted scatter.
+
+    Pools may be per-layer [nbp1, bs, K, d] or STACKED [L, nbp1, bs, K, d]
+    with ``layer`` (traced scalar) selecting the layer — the stacked form is
+    the fast path: the model passes the whole cache straight through every
+    layer of its scan and the kernels index it in HBM, so no per-layer pool
+    slice is ever materialized. ``no_past=True`` (static) skips the past
+    kernel when the engine knows every chunk starts at position 0.
+    Dispatches to the decode work-list kernel (tq == 1) or the
+    past+self-flash pair (tq > 1); see the section comment above.
+    Returns [N, H, d]."""
     if interpret is None:
         interpret = not _on_tpu()
     N, H, d = q.shape
-    bs, K = k_pool.shape[1], k_pool.shape[2]
-    rep = H // K
-    A = N // tq
-    nb_max = block_tables.shape[1]
+    K = k_self.shape[-2]
+    if k_pool.ndim == 5:                  # unfolded stacked [L,nbp1,bs,K,d]
+        k_pool = k_pool.reshape(*k_pool.shape[:3], K * d)
+        v_pool = v_pool.reshape(*v_pool.shape[:3], K * d)
+    elif k_pool.shape[-1] == d and k_pool.shape[-2] == K:
+        # per-layer unfolded [nbp1, bs, K, d] (tests / direct calls)
+        k_pool = k_pool.reshape(1, *k_pool.shape[:2], K * d)
+        v_pool = v_pool.reshape(1, *v_pool.shape[:2], K * d)
+        layer = jnp.zeros((), jnp.int32)
+    if layer is None:
+        raise ValueError("stacked pools need a layer index")
+    bs = k_pool.shape[2]
     # Mosaic wants 128-lane-aligned DMA chunks and reshapes; geometries off
     # the serving sweet spot (small head_dim models, tiny test configs) take
     # the dense-gather XLA path instead — numerically identical
-    if not interpret and (d % 128 or bs % 8):
-        return xla_ragged_attention(q, k_self, v_self, k_pool, v_pool,
-                                    block_tables, atom_slot, atom_pos0,
-                                    atom_len, tq, window=window)
-    kernel = functools.partial(
-        _ragged_kernel, scale=1.0 / math.sqrt(d), bs=bs, tq=tq, K=K, rep=rep,
-        nb_max=nb_max, window=window)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(A,),
-        in_specs=[
-            pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
-            pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
-            pl.BlockSpec((1, tq, K * d), lambda a, *_: (a, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec((tq, H, d), lambda a, *_: (a, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, bs, K * d), k_pool.dtype),
-            pltpu.VMEM((2, bs, K * d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.VMEM((K, tq * rep, 128), jnp.float32),
-            pltpu.VMEM((K, tq * rep, 128), jnp.float32),
-            pltpu.VMEM((K, tq * rep, d), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((N, H, d), q.dtype),
-        interpret=interpret,
-    )(atom_slot.astype(jnp.int32), atom_pos0.astype(jnp.int32),
-      atom_len.astype(jnp.int32), block_tables.astype(jnp.int32),
-      q, k_self.reshape(A, tq, K * d).astype(k_pool.dtype),
-      v_self.reshape(A, tq, K * d).astype(v_pool.dtype),
-      k_pool.reshape(k_pool.shape[0], bs, K * d),
-      v_pool.reshape(v_pool.shape[0], bs, K * d))
+    if not interpret and (d % 128 or bs % 8 or (tq > 1 and bs % 128)):
+        kp = jax.lax.dynamic_index_in_dim(k_pool, layer, keepdims=False)
+        vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
+        return xla_ragged_attention(
+            q, k_self, v_self, kp.reshape(*kp.shape[:2], K, d),
+            vp.reshape(*vp.shape[:2], K, d), block_tables, atom_slot,
+            atom_pos0, atom_len, tq, window=window)
+    if tq == 1:
+        return _decode_attention(q, k_self, v_self, k_pool, v_pool, layer,
+                                 block_tables, atom_slot, atom_pos0,
+                                 atom_len, window=window, interpret=interpret)
+    return _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
+                              block_tables, atom_slot, atom_pos0, atom_len,
+                              tq, window=window, interpret=interpret,
+                              no_past=no_past)
 
 
 def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
@@ -463,7 +898,9 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                               atom_slot: jax.Array, atom_pos0: jax.Array,
                               atom_len: jax.Array, tq: int,
                               axis: str = "tp",
-                              window: Optional[int] = None) -> jax.Array:
+                              window: Optional[int] = None,
+                              layer: Optional[jax.Array] = None,
+                              no_past: bool = False) -> jax.Array:
     """Tensor-parallel :func:`ragged_paged_attention`: heads embarrassingly
     parallel, q sharded on H, the atom KV and pools on K under shard_map."""
     from jax.sharding import PartitionSpec as P
@@ -473,21 +910,37 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
             or mesh.shape[axis] <= 1:
         return ragged_paged_attention(q, k_self, v_self, k_pool, v_pool,
                                       block_tables, atom_slot, atom_pos0,
-                                      atom_len, tq, window=window)
+                                      atom_len, tq, window=window,
+                                      layer=layer, no_past=no_past)
     tp = mesh.shape[axis]
-    H, K = q.shape[1], k_pool.shape[2]
+    H = q.shape[1]
+    d = q.shape[2]
+    K = k_self.shape[-2]
     assert H % tp == 0 and K % tp == 0, (
         f"tp={tp} must divide num_heads={H} and num_kv_heads={K}")
+    if k_pool.ndim == 5:                       # unfolded stacked
+        pool_spec = P(None, None, None, axis, None)
+    elif k_pool.shape[-1] == d and k_pool.shape[-2] == K:
+        pool_spec = P(None, None, axis, None)  # per-layer unfolded
+    else:
+        pool_spec = P(None, None, None, axis)  # stacked lane-folded
+    if layer is None:
+        layer = jnp.zeros((), jnp.int32)
+
+    def shard_fn(q, ks, vs, kp, vp, bt, a_s, a_p, a_l, lay):
+        return ragged_paged_attention(q, ks, vs, kp, vp, bt, a_s, a_p, a_l,
+                                      tq, window=window, layer=lay,
+                                      no_past=no_past)
+
     return jax.shard_map(
-        functools.partial(ragged_paged_attention, tq=tq, window=window),
+        shard_fn,
         in_specs=(P(None, axis, None), P(None, axis, None),
-                  P(None, axis, None), P(None, None, axis, None),
-                  P(None, None, axis, None), P(None, None), P(None), P(None),
-                  P(None)),
+                  P(None, axis, None), pool_spec, pool_spec,
+                  P(None, None), P(None), P(None), P(None), P()),
         out_specs=P(None, axis, None),
         check_vma=False,
     )(q, k_self, v_self, k_pool, v_pool, block_tables, atom_slot, atom_pos0,
-      atom_len)
+      atom_len, layer)
 
 
 def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
@@ -498,10 +951,15 @@ def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
     in-place scatter (free under buffer donation — the per-layer scatter
     inside a scan copies the whole pool every layer instead).
 
-    ``pool``: [L, nb+1, bs, K, d]; ``new_rows``: [L, N, K, d]; metadata [N].
-    Invalid rows are dropped (out-of-bounds index + mode='drop')."""
-    L, nbp1, bs, K, d = pool.shape
+    ``pool``: lane-folded [L, nb+1, bs, K*d] (or unfolded [L, nb+1, bs, K,
+    d]); ``new_rows``: [L, N, K, d] or [L, N, K*d]; metadata [N]. Invalid
+    rows are dropped (out-of-bounds index + mode='drop')."""
+    unfolded_shape = pool.shape if pool.ndim == 5 else None
+    if unfolded_shape:
+        pool = pool.reshape(*pool.shape[:3], -1)
+    L, nbp1, bs, KD = pool.shape
     N = new_rows.shape[1]
+    rows = new_rows.reshape(L, N, KD)
     bt_rows = block_tables[tok_slot]                          # [N, nb_max]
     logical = jnp.clip(tok_pos // bs, 0, bt_rows.shape[1] - 1)
     phys = jnp.take_along_axis(bt_rows, logical[:, None], axis=1)[:, 0]
@@ -512,11 +970,14 @@ def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
         # one-past-the-end is definitively out of bounds → mode='drop'
         # discards the row (negative indices would WRAP, not drop)
         idx = jnp.where(valid[None, :], idx, L * nbp1 * bs)
-    flat = pool.reshape(L * nbp1 * bs, K, d)
+    flat = pool.reshape(L * nbp1 * bs, KD)
     flat = flat.at[idx.reshape(-1)].set(
-        new_rows.reshape(L * N, K, d).astype(pool.dtype),
+        rows.reshape(L * N, KD).astype(pool.dtype),
         mode="drop", unique_indices=True)
-    return flat.reshape(pool.shape)
+    out = flat.reshape(pool.shape)
+    if unfolded_shape:
+        out = out.reshape(unfolded_shape)
+    return out
 
 
 def xla_ragged_attention(q, k_self, v_self, k_pool, v_pool, block_tables,
