@@ -25,7 +25,7 @@ from typing import Dict, Sequence
 import numpy as np
 
 
-def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
+def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32, 128),
                         prompt: int = 512, decode_steps: int = 64,
                         prefill_reps: int = 6,
                         params=None) -> Dict[str, object]:
@@ -103,13 +103,21 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
     eng.flush([30_000])
 
     # ---- decode at each occupancy -----------------------------------------
+    def build_context(uids):
+        """Batched whole-prompt prefill in groups of 32 (bounds the [B, T]
+        per-layer KV stash the prefill step materializes)."""
+        first = {}
+        for i in range(0, len(uids), 32):
+            grp = uids[i:i + 32]
+            r = eng.put(grp, [rng.integers(0, cfg.vocab_size, prompt)
+                              for _ in grp])
+            first.update({u: int(np.argmax(r[u])) for u in grp})
+        return first
+
     decode = {}
     for occ in occupancies:
         uids = list(range(occ))
-        first = {}
-        for uid in uids:                       # build context (untimed)
-            r = eng.put([uid], [rng.integers(0, cfg.vocab_size, prompt)])
-            first[uid] = int(np.argmax(r[uid]))
+        first = build_context(uids)
         toks = [first[u] for u in uids]
         # warmup at the SAME steps count: steps is a static arg of the fused
         # loop, so a different value would compile inside the timed region
@@ -134,6 +142,44 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
         }
         eng.flush(uids)
 
+    # sampled decode at the top occupancy (FastGen serves sampled tokens;
+    # the fused loop must hold >=90% of greedy throughput with
+    # temperature/top-k/top-p active)
+    occ = max(occupancies)
+    uids = list(range(occ))
+    build_context(uids)
+    toks = [0] * occ
+    eng.decode_batch(uids, toks, steps=decode_steps, temperature=0.8,
+                     top_k=50, top_p=0.95, seed=1)   # warmup/compile
+    t0 = time.perf_counter()
+    eng.decode_batch(uids, toks, steps=decode_steps, temperature=0.8,
+                     top_k=50, top_p=0.95, seed=2)
+    dt = time.perf_counter() - t0
+    sampled_tps = occ * decode_steps / dt
+    decode[str(occ)]["sampled_tokens_per_sec"] = round(sampled_tps, 1)
+    decode[str(occ)]["sampled_vs_greedy"] = round(
+        sampled_tps / decode[str(occ)]["tokens_per_sec"], 3)
+    eng.flush(uids)
+
+    # int8 KV pool at the top occupancy: KV reads are the decode bound on a
+    # bandwidth-limited chip, so halving the bytes is the big lever
+    del eng
+    eng = InferenceEngineV2(model, params=params, max_sequences=max_seqs,
+                            max_seq_len=ctx, block_size=128, kv_dtype="int8")
+    for occ in [o for o in occupancies if o >= 32] or [max(occupancies)]:
+        uids = list(range(occ))
+        build_context(uids)
+        toks = [0] * occ
+        eng.decode_batch(uids, toks, steps=decode_steps)  # warmup/compile
+        t0 = time.perf_counter()
+        eng.decode_batch(uids, toks, steps=decode_steps)
+        dt = time.perf_counter() - t0
+        decode[f"{occ}_int8kv"] = {
+            "tokens_per_sec": round(occ * decode_steps / dt, 1),
+            "ms_per_token": round(dt / decode_steps * 1e3, 3),
+        }
+        eng.flush(uids)
+
     return {
         "decode": decode,
         "prefill_tokens_per_sec": round(prefill_dev_tps, 1),
@@ -148,6 +194,11 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32),
                 "block_size": eng.block_size},
         "model_params_m": round(cfg.num_params_estimate() / 1e6, 1),
         "device": getattr(dev, "device_kind", str(dev)),
+        # context for roofline math: this tunneled v5e sustains ~150 GB/s
+        # HBM streaming (measured via chunk-size-independent Pallas stream
+        # reads; big XLA copies ~300-400 GB/s), not the 819 GB/s spec —
+        # decode is KV/weight-bandwidth-bound at these rates
+        "measured_hbm_stream_gbps": 150,
     }
 
 

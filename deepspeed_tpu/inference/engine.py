@@ -32,11 +32,30 @@ def sample_token(logits, temperature: float, top_k: int, rng,
     if temperature <= 0.0:
         tok = jnp.argmax(logits, axis=-1)
         lp = logits.astype(jnp.float32)
+    elif top_k > 0:
+        # fast path: sample within the top-k subset — top-p then needs a
+        # cumsum over k elements instead of a full-vocab sort (which costs
+        # ~30% of fused-loop decode throughput at V=32k)
+        lp_full = (logits / temperature).astype(jnp.float32)
+        vals, idx = jax.lax.top_k(lp_full, top_k)       # sorted descending
+        if top_p < 1.0:
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix whose mass reaches top_p (cutoff
+            # token inclusive): entries whose PRECEDING mass is < top_p
+            keep = jnp.concatenate(
+                [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p],
+                axis=-1)
+            vals = jnp.where(keep, vals, -jnp.inf)
+        j = jax.random.categorical(rng, vals, axis=-1)
+        tok = jnp.take_along_axis(idx, j[:, None], axis=-1)[:, 0]
+        if not with_logprob:
+            return tok
+        # behavior-policy logprob under the filtered distribution
+        logp_k = jax.nn.log_softmax(vals, axis=-1)
+        return tok, jnp.take_along_axis(logp_k, j[:, None], axis=-1)[:, 0]
     else:
         lp = (logits / temperature).astype(jnp.float32)
-        if top_k > 0:
-            vals, _ = jax.lax.top_k(lp, top_k)
-            lp = jnp.where(lp < vals[:, -1:], -jnp.inf, lp)
         if top_p < 1.0:
             # nucleus: keep the smallest prefix of the sorted distribution
             # whose mass reaches top_p (the cutoff token inclusive)

@@ -37,7 +37,7 @@ class InferenceEngineV2:
                  max_seq_len: Optional[int] = None, block_size: int = 128,
                  num_blocks: Optional[int] = None, paged: bool = True,
                  packed: bool = True, topology=None,
-                 mesh: Optional[dict] = None):
+                 mesh: Optional[dict] = None, kv_dtype: str = "bf16"):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from deepspeed_tpu.parallel import build_mesh
@@ -82,21 +82,33 @@ class InferenceEngineV2:
         self.params = params
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got "
+                             f"{kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        if kv_dtype == "int8" and not (paged and packed):
+            raise ValueError("int8 KV needs the packed paged engine")
         if paged:
             self.num_blocks = self.state.allocator.num_blocks
-            cache = model.init_paged_kv_cache(self.num_blocks, block_size)
+            cache = model.init_paged_kv_cache(self.num_blocks, block_size,
+                                              quantize=kv_dtype == "int8")
             # pool sharded over tp on the lane-folded kv-head dim
-            # ([L, nb+1, bs, K*d]: contiguous d-lanes per kv head)
+            # ([L, nb+1, bs, K*d]: contiguous d-lanes per kv head);
+            # per-token int8 scales replicated (identical on every shard)
             kv_spec = shd.filter_spec(P(None, None, None, "tp"),
                                       self.mesh.axis_names)
+            cache_spec = {"k": kv_spec, "v": kv_spec}
+            if "kv_scale" in cache:
+                cache_spec["kv_scale"] = P(None, None, None, None)
             self.cache = jax.device_put(
-                cache, NamedSharding(self.mesh, kv_spec))
+                cache, {k: NamedSharding(self.mesh, s)
+                        for k, s in cache_spec.items()})
             self._pos = np.zeros((max_sequences,), np.int32)
             # pin the output cache to the SAME sharding as the input: an
             # XLA-chosen output spec would change the next call's signature
             # and retrace/recompile every step program once per alternation
-            kv_out = {"k": NamedSharding(self.mesh, kv_spec),
-                      "v": NamedSharding(self.mesh, kv_spec)}
+            kv_out = {k: NamedSharding(self.mesh, s)
+                      for k, s in cache_spec.items()}
             # donate the pool: the step returns the updated {'k','v'} dict and
             # self.cache is immediately reassigned — without donation XLA would
             # double-buffer the whole pool and copy all unchanged blocks
@@ -109,7 +121,7 @@ class InferenceEngineV2:
                                         out_shardings=(None, kv_out))
             self._decode_loop = jax.jit(self._multi_decode,
                                         donate_argnums=(1,),
-                                        static_argnums=(6,),
+                                        static_argnums=(6, 9, 10, 11),
                                         out_shardings=(None, kv_out))
             self._prefill_step = jax.jit(self._prefill_impl,
                                          donate_argnums=(3,),
@@ -145,11 +157,16 @@ class InferenceEngineV2:
         return bt
 
     def _multi_decode(self, params, cache, bt, slots, pos0, tok0, steps: int,
-                      valid=None):
-        """``steps`` greedy decode iterations fused into ONE device program
-        (lax.scan): the TPU analog of the reference v1 engine's CUDA-graph
-        replay (inference/engine.py:497) — per-step host dispatch and
-        transfers vanish, so decode throughput reflects the chip.
+                      valid=None, rng=None, temperature: float = 0.0,
+                      top_k: int = 0, top_p: float = 1.0):
+        """``steps`` greedy-or-sampled decode iterations fused into ONE device
+        program (lax.scan): the TPU analog of the reference v1 engine's
+        CUDA-graph replay (inference/engine.py:497) — per-step host dispatch
+        and transfers vanish, so decode throughput reflects the chip.
+        ``temperature``/``top_k``/``top_p`` (static) select the v1 engine's
+        ``sample_token`` math inside the loop; ``rng`` is the base PRNG key,
+        folded per step (sampling adds one categorical over [B, V] per step
+        — a rounding error next to the layer stack).
 
         The paged pool stays READ-ONLY across the whole scan: per-step
         appends would force XLA to snapshot-copy the pool at every Pallas
@@ -161,7 +178,8 @@ class InferenceEngineV2:
         per occupancy)."""
         import jax.numpy as jnp
 
-        from deepspeed_tpu.ops.paged_attention import packed_kv_append
+        from deepspeed_tpu.ops.paged_attention import (
+            packed_kv_append, packed_kv_append_quant)
 
         cfg = self.cfg
         B = tok0.shape[0]
@@ -177,7 +195,14 @@ class InferenceEngineV2:
             logits, tail = self.module.forward_decode_tail(
                 params, toks, cache, {"k": tk, "v": tv}, t, bt, slots, pos0,
                 valid)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if temperature > 0.0:
+                from deepspeed_tpu.inference.engine import sample_token
+
+                sub = jax.random.fold_in(rng, t)
+                nxt = sample_token(logits, temperature, top_k, sub,
+                                   top_p=top_p).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (tail["k"], tail["v"], nxt), nxt
 
         (tk, tv, _), out = jax.lax.scan(
@@ -190,17 +215,28 @@ class InferenceEngineV2:
         pos2 = (pos0[:, None]
                 + jnp.arange(steps, dtype=pos0.dtype)[None, :]).reshape(-1)
         valid2 = jnp.repeat(valid, steps)
+        if "kv_scale" in cache:
+            nk, sc1 = packed_kv_append_quant(cache["k"], cache["kv_scale"],
+                                             rows_k, bt, slot2, pos2, 0,
+                                             valid2)
+            nv, sc2 = packed_kv_append_quant(cache["v"], sc1, rows_v, bt,
+                                             slot2, pos2, 1, valid2)
+            return out, {"k": nk, "v": nv, "kv_scale": sc2}
         nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
         nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)
         return out, {"k": nk, "v": nv}          # out: [steps, B]
 
     def decode_batch(self, batch_uids: Sequence[int],
-                     batch_tokens: Sequence[int], steps: int
+                     batch_tokens: Sequence[int], steps: int,
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 1.0, seed: int = 0
                      ) -> Dict[int, np.ndarray]:
-        """Advance every listed sequence ``steps`` tokens by on-device greedy
-        decode, starting from each sequence's ``batch_tokens`` entry. Returns
-        the generated tokens per uid ([steps] each). One dispatch + one fetch
-        regardless of ``steps`` — the throughput serving mode."""
+        """Advance every listed sequence ``steps`` tokens by on-device decode
+        (greedy at ``temperature=0``, else the v1 engine's temperature/
+        top-k/nucleus sampling), starting from each sequence's
+        ``batch_tokens`` entry. Returns the generated tokens per uid
+        ([steps] each). One dispatch + one fetch regardless of ``steps`` —
+        the throughput serving mode."""
         if not (self.paged and self.packed):
             raise ValueError("decode_batch needs the packed paged engine")
         if not self.state.can_schedule_batch(batch_uids,
@@ -222,7 +258,8 @@ class InferenceEngineV2:
             out, self.cache = self._decode_loop(
                 self.params, self.cache, jnp.asarray(self._block_tables()),
                 jnp.asarray(slots), jnp.asarray(pos0), jnp.asarray(tok0),
-                steps, jnp.asarray(valid))
+                steps, jnp.asarray(valid), jax.random.key(seed),
+                float(temperature), int(top_k), float(top_p))
             toks = np.asarray(out)            # [steps, bpad]
         for i, d in enumerate(descs):
             self._pos[d.slot] = d.seen_tokens + steps
@@ -237,7 +274,8 @@ class InferenceEngineV2:
         """Whole-prompt prefill + one-scatter pool append (jitted, cache
         donated — the model path never READS the pool, so the append stays
         in place)."""
-        from deepspeed_tpu.ops.paged_attention import packed_kv_append
+        from deepspeed_tpu.ops.paged_attention import (
+            packed_kv_append, packed_kv_append_quant)
 
         logits, kv = self.module.forward_prefill(params, ids, lengths)
         L = kv["k"].shape[0]
@@ -248,9 +286,21 @@ class InferenceEngineV2:
         slot2 = jnp.repeat(slots, T)
         pos2 = jnp.tile(jnp.arange(T, dtype=jnp.int32), Bp)
         valid2 = (jnp.arange(T)[None, :] < lengths[:, None]).reshape(-1)
+        if "kv_scale" in cache:
+            nk, sc1 = packed_kv_append_quant(cache["k"], cache["kv_scale"],
+                                             rows_k, bt, slot2, pos2, 0,
+                                             valid2)
+            nv, sc2 = packed_kv_append_quant(cache["v"], sc1, rows_v, bt,
+                                             slot2, pos2, 1, valid2)
+            return logits, {"k": nk, "v": nv, "kv_scale": sc2}
         nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
         nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)
         return logits, {"k": nk, "v": nv}
+
+    # cap on bpad*T_pad per prefill step: bounds the [L, B, T, K, d] KV
+    # stash forward_prefill materializes (~L*K*d*4B per token of transient
+    # HBM) — larger fresh batches are split into successive steps
+    PREFILL_BATCH_TOKENS = 16384
 
     def _prefill_whole(self, batch_uids: Sequence[int], chunks
                        ) -> Dict[int, np.ndarray]:
@@ -260,6 +310,15 @@ class InferenceEngineV2:
             raise RuntimeError(
                 f"cannot schedule uids={list(batch_uids)} "
                 f"(+{[len(c) for c in chunks]} tokens jointly)")
+        longest = max(len(c) for c in chunks)
+        T_pad0 = max(_MIN_TILE, 1 << (longest - 1).bit_length())
+        group = max(1, self.PREFILL_BATCH_TOKENS // T_pad0)
+        if len(batch_uids) > group:
+            results: Dict[int, np.ndarray] = {}
+            for i in range(0, len(batch_uids), group):
+                results.update(self._prefill_whole(
+                    batch_uids[i:i + group], chunks[i:i + group]))
+            return results
         descs = [self.state.schedule(uid, len(c))
                  for uid, c in zip(batch_uids, chunks)]
         B = len(descs)

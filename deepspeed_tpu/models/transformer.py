@@ -282,6 +282,17 @@ def attn_out_proj(attn: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Arr
     return o + w["bo"] if "bo" in w else o
 
 
+def _attn_takes_window(attn_fn: Callable) -> bool:
+    """Whether a registered attention impl accepts the ``window`` kwarg
+    (impls without it — e.g. ring/ulysses SP wrappers — get the masked XLA
+    fallback instead)."""
+    import inspect
+
+    params = inspect.signature(attn_fn).parameters
+    return ("window" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()))
+
+
 def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                     freqs: Optional[jax.Array],
                     attn_fn: Callable,
@@ -298,12 +309,7 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
         # windowed families (mistral/qwen2): the flash kernel takes the
         # window natively (block-skipping); impls without window support
         # (ring/ulysses SP wrappers) fall back to the masked XLA path
-        import inspect
-
-        params = inspect.signature(attn_fn).parameters
-        takes_window = ("window" in params or any(
-            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()))
-        if takes_window:
+        if _attn_takes_window(attn_fn):
             out = attn_fn(q, k, v, causal=True, window=cfg.sliding_window)
         else:
             out = xla_attention(q, k, v, causal=True,
@@ -824,7 +830,8 @@ class TransformerLM:
 
     # ---- paged decode path (blocked KV pool) ------------------------------
     def init_paged_kv_cache(self, num_blocks: int, block_size: int = 128,
-                            dtype: Optional[Any] = None) -> Dict[str, jax.Array]:
+                            dtype: Optional[Any] = None,
+                            quantize: bool = False) -> Dict[str, jax.Array]:
         """Allocate the global blocked KV pool (inference v2 kv_cache.py parity):
         ``[L, num_blocks+1, block_size, K*d]`` — the last block is scratch for
         padded lanes. HBM is proportional to ``num_blocks``, not
@@ -834,11 +841,21 @@ class TransformerLM:
         K up to the sublane tile, so "reshaping" it to ``[.., K*d]`` at the
         kernel boundary is a full relayout copy of the pool — XLA re-issues
         it at every Pallas read (measured ~1.8 ms x layers x steps on v5e).
-        Folding at allocation makes the kernels' DMA view the storage view."""
+        Folding at allocation makes the kernels' DMA view the storage view.
+
+        ``quantize=True`` allocates int8 pools plus a per-token dequant
+        scale array ``kv_scale`` [L, nb+1, 1, 2*block_size] (k scales in lanes
+        [0, bs), v in [bs, 2bs)) — KV HBM traffic halves, which is the
+        decode bound on a bandwidth-limited chip."""
         cfg = self.cfg
         dt = jnp.dtype(dtype or cfg.dtype)
         shape = (cfg.num_layers, num_blocks + 1, block_size,
                  cfg.num_kv_heads * cfg.head_dim)
+        if quantize:
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "kv_scale": jnp.zeros(shape[:2] + (1, 2 * block_size),
+                                          jnp.float32)}
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     def forward_with_paged_cache(self, params: Params, input_ids: jax.Array,
@@ -857,6 +874,10 @@ class TransformerLM:
         from deepspeed_tpu.ops.paged_attention import (paged_attention_tp,
                                                        paged_update)
 
+        if "kv_scale" in cache:
+            raise NotImplementedError(
+                "the dense-tile escape hatch does not support the int8 KV "
+                "pool; use the packed path (packed=True)")
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         B, t = input_ids.shape
@@ -944,10 +965,12 @@ class TransformerLM:
         Returns (logits [G, V], updated cache).
         """
         from deepspeed_tpu.ops.paged_attention import (
-            packed_kv_append, ragged_paged_attention_tp)
+            packed_kv_append, packed_kv_append_quant,
+            ragged_paged_attention_tp)
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
+        kv_scale = cache.get("kv_scale")
         N = token_ids.shape[0]
         dr = N if decode_rows is None else decode_rows
         if (N - dr) % tile_tq:
@@ -991,13 +1014,14 @@ class TransformerLM:
                         parts.append(ragged_paged_attention_tp(
                             q2[:dr], k2[:dr], v2[:dr], cache["k"], cache["v"],
                             block_tables, a_slot_d, a_pos_d, a_len_d, tq=1,
-                            window=cseg.sliding_window, layer=li))
+                            window=cseg.sliding_window, layer=li,
+                            kv_scale=kv_scale))
                     if n_tiles:
                         parts.append(ragged_paged_attention_tp(
                             q2[dr:], k2[dr:], v2[dr:], cache["k"], cache["v"],
                             block_tables, a_slot_t, a_pos_t, a_len_t,
                             tq=tile_tq, window=cseg.sliding_window, layer=li,
-                            no_past=tiles_no_past))
+                            no_past=tiles_no_past, kv_scale=kv_scale))
                     out = (parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))
                     return out[:, None]                         # [N, 1, H, d]
@@ -1019,13 +1043,23 @@ class TransformerLM:
             vr_parts.append(vr)
         krows = kr_parts[0] if len(kr_parts) == 1 else jnp.concatenate(kr_parts)
         vrows = vr_parts[0] if len(vr_parts) == 1 else jnp.concatenate(vr_parts)
-        nk = packed_kv_append(cache["k"], krows, block_tables, tok_slot,
-                              tok_pos, valid)
-        nv = packed_kv_append(cache["v"], vrows, block_tables, tok_slot,
-                              tok_pos, valid)
+        if kv_scale is not None:
+            nk, sc1 = packed_kv_append_quant(cache["k"], kv_scale, krows,
+                                             block_tables, tok_slot, tok_pos,
+                                             0, valid)
+            nv, sc2 = packed_kv_append_quant(cache["v"], sc1, vrows,
+                                             block_tables, tok_slot, tok_pos,
+                                             1, valid)
+            new_cache = {"k": nk, "v": nv, "kv_scale": sc2}
+        else:
+            nk = packed_kv_append(cache["k"], krows, block_tables, tok_slot,
+                                  tok_pos, valid)
+            nv = packed_kv_append(cache["v"], vrows, block_tables, tok_slot,
+                                  tok_pos, valid)
+            new_cache = {"k": nk, "v": nv}
         x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
         logits = x[gather_idx] @ self._head(params).astype(dt)   # [G, V]
-        return logits, {"k": nk, "v": nv}
+        return logits, new_cache
 
     PREFILL_MAX = 4096   # widest whole-prompt prefill (longer prompts chunk)
 
@@ -1068,13 +1102,7 @@ class TransformerLM:
                 def attn_cache_fn(q, k, v):
                     kv["k"], kv["v"] = k, v
                     if cseg.sliding_window is not None:
-                        import inspect
-
-                        sig = inspect.signature(attn_fn).parameters
-                        takes_window = ("window" in sig or any(
-                            p.kind is inspect.Parameter.VAR_KEYWORD
-                            for p in sig.values()))
-                        if not takes_window:  # impls without native window
+                        if not _attn_takes_window(attn_fn):
                             return xla_attention(
                                 q, k, v, causal=True,
                                 window=cseg.sliding_window)
@@ -1161,7 +1189,8 @@ class TransformerLM:
                     window = cseg.sliding_window
                     acc, m_k, l_k = decode_pool_partials_tp(
                         q2, cache["k"], cache["v"], li, block_tables, slots,
-                        pos_base, window=window, row_pos=row_pos)
+                        pos_base, window=window, row_pos=row_pos,
+                        kv_scale=cache.get("kv_scale"))
                     # append self into the tail, then attend tail cols <= t
                     tk2 = jax.lax.dynamic_update_slice(
                         tk, k2[None, :, None].astype(tk.dtype),

@@ -286,9 +286,13 @@ _DMA_DEPTH = 2      # work-item fetches kept in flight across the work list
 
 
 def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
-                      bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem):
+                      bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem,
+                      spool=None, sbuf=None):
     """Shared work-list DMA machinery: item j = G consecutive logical KV
-    blocks of atom j//NG, streamed from the STACKED pool layer li."""
+    blocks of atom j//NG, streamed from the STACKED pool layer li. With an
+    int8 pool, ``spool`` [L, nbp1, 1, 2*bs] carries the per-token
+    dequant scales (k in lanes [0,bs), v in [bs,2bs)) — one extra f32 row
+    copy per block."""
 
     def item_dmas(j, dst):
         jc = jnp.clip(j, 0, n_items - 1)
@@ -306,6 +310,13 @@ def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
             copies.append(pltpu.make_async_copy(
                 vpool.at[li, bid], vbuf.at[dst, pl.ds(gg * bs, bs)],
                 dsem.at[dst, 1, gg]))
+            if spool is not None:
+                # sbuf rows are [1, 2bs] leading-dim slices (Mosaic requires
+                # minor-dim slices be tile-aligned; a [G, 2bs] row pick
+                # along dim 1 is not)
+                copies.append(pltpu.make_async_copy(
+                    spool.at[li, bid], sbuf.at[dst * G + gg],
+                    dsem.at[dst, 2, gg]))
         return copies
 
     def item_active(j):
@@ -331,12 +342,18 @@ def _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window):
     return pos0, lo.astype(jnp.int32), ng
 
 
-def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
-                   bt_ref, q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
-                   kbuf, vbuf, dsem, m_scr, l_scr, acc_scr, *,
-                   scale: float, bs: int, K: int, rep: int, nb_max: int,
-                   NG: int, window):
+def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
+                   nb_max: int, NG: int, window, quantized: bool):
     """One work item = G consecutive past-KV blocks of one decode atom."""
+    if quantized:
+        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref, bt_ref,
+         q_ref, kpool, vpool, spool, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, sbuf, dsem, m_scr, l_scr, acc_scr) = refs
+    else:
+        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref, bt_ref,
+         q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, dsem, m_scr, l_scr, acc_scr) = refs
+        spool = sbuf = None
     i = pl.program_id(0)
     n_items = pl.num_programs(0)
     G, DEPTH = _DECODE_G, _DMA_DEPTH
@@ -346,7 +363,7 @@ def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
     g = jax.lax.rem(i, NG)
     item_dmas, item_active = _worklist_helpers(
         n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
-        kpool, vpool, kbuf, vbuf, dsem)
+        kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
 
     @pl.when(i == 0)
     def _warmup():
@@ -369,11 +386,20 @@ def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
         dst = jax.lax.rem(i, DEPTH)
         for c in item_dmas(i, dst):
             c.wait()
-        kb = kbuf[dst]                           # [G*bs, K*d]
-        vb = vbuf[dst]
         qb = q_ref[0]                            # [H, K*d] zero-padded
+        if quantized:                 # int8 rows, per-token dequant scales
+            kb = kbuf[dst].astype(qb.dtype)
+            vb = vbuf[dst].astype(qb.dtype)
+            sc = sbuf[pl.ds(dst * G, G), 0]      # [G, 2*bs] f32
+            sck = sc[:, :bs].reshape(1, G * bs)
+            scv = sc[:, bs:].reshape(1, G * bs)
+        else:
+            kb = kbuf[dst]                       # [G*bs, K*d]
+            vb = vbuf[dst]
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if quantized:
+            s = s * sck
         pos0 = pos0_ref[a]
         colpos = ((lo_ref[a] + g * G) * bs
                   + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
@@ -388,8 +414,8 @@ def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
         l_scr[:] = jnp.broadcast_to(
             l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
-        ob = jax.lax.dot_general(p.astype(vb.dtype), vb,
-                                 (((1,), (0,)), ((), ())),
+        pv = (p * scv if quantized else p).astype(vb.dtype)
+        ob = jax.lax.dot_general(pv, vb, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         # head-select the GQA group's lane block out of [H, K*d]
         obh = ob.reshape(H, K, d)
@@ -417,13 +443,14 @@ def _decode_kernel(li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref,
 
 def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
                          atom_pos0, *, window=None, row_pos=None,
-                         interpret=None):
+                         interpret=None, kv_scale=None):
     """(acc, m, l) flash-decode partials of each decode row's attention over
     its POOL-cached past (positions < pos0). ``row_pos`` is the query's true
     position (defaults to pos0) — it only matters for sliding windows, e.g.
     in the fused loop where rows advance while the pool frontier stays put.
-    q [A, H, d]; pools STACKED lane-folded [L, nbp1, bs, K*d]. Returns fp32
-    acc [A, H, d] (unnormalized), m/l [A, H]."""
+    q [A, H, d]; pools STACKED lane-folded [L, nbp1, bs, K*d] — bf16, or
+    int8 with ``kv_scale`` [L, nbp1, 1, 2*bs] per-token dequant scales.
+    Returns fp32 acc [A, H, d] (unnormalized), m/l [A, H]."""
     if interpret is None:
         interpret = not _on_tpu()
     A, H, d = q.shape
@@ -431,12 +458,13 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     rep = H // K
     nb_max = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d)
+    quantized = kv_scale is not None
     if row_pos is None:
         row_pos = atom_pos0
     if not interpret and (d % 128 or bs % 8):
         return xla_decode_partials(q, k_pool, v_pool, layer, block_tables,
                                    atom_slot, atom_pos0, window=window,
-                                   row_pos=row_pos)
+                                   row_pos=row_pos, kv_scale=kv_scale)
     G = _DECODE_G
     NG = max(1, -(-nb_max // G))
     pos0, lo, ng = _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window)
@@ -444,32 +472,42 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     # zero-padded q_big: head h lives in lane block h//rep
     hsel = (jnp.arange(K)[None, :] == (jnp.arange(H) // rep)[:, None])
     q_big = jnp.where(hsel[None, :, :, None], q[:, :, None, :], 0)
-    q_big = q_big.reshape(A, H, K * d).astype(k_pool.dtype)
+    q_big = q_big.reshape(A, H, K * d)
+    if q_big.dtype not in (jnp.bfloat16, jnp.float32):
+        q_big = q_big.astype(jnp.bfloat16)
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, bs=bs, K=K, rep=rep, nb_max=nb_max,
-        NG=NG, window=window)
+        NG=NG, window=window, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, H, K * d), lambda i, *_: (i // NG, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
+        pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+        pltpu.SemaphoreType.DMA((_DMA_DEPTH, 3 if quantized else 2, G)),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, d), jnp.float32),
+    ]
+    operands = [q_big, k_pool, v_pool]
+    if quantized:
+        in_specs.insert(3, pl.BlockSpec(memory_space=pl.ANY))
+        scratch.insert(2, pltpu.VMEM((_DMA_DEPTH * G, 1, 2 * bs),
+                                     jnp.float32))
+        operands.append(kv_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=7,
         grid=(A * NG,),
-        in_specs=[
-            pl.BlockSpec((1, H, K * d), lambda i, *_: (i // NG, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, H, d), lambda i, *_: (i // NG, 0, 0)),
             pl.BlockSpec((1, H, 128), lambda i, *_: (i // NG, 0, 0)),
             pl.BlockSpec((1, H, 128), lambda i, *_: (i // NG, 0, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
-            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((_DMA_DEPTH, 2, G)),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, d), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     acc, m_p, l_p = pl.pallas_call(
         kernel, grid_spec=grid_spec,
@@ -481,12 +519,13 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
         interpret=interpret,
     )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32), pos0,
       row_pos.astype(jnp.int32), lo, ng, block_tables.astype(jnp.int32),
-      q_big, k_pool, v_pool)
+      *operands)
     return acc, m_p[..., 0], l_p[..., 0]
 
 
 def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
-                        atom_pos0, *, window=None, row_pos=None):
+                        atom_pos0, *, window=None, row_pos=None,
+                        kv_scale=None):
     """Dense-gather reference/fallback for :func:`decode_pool_partials`
     (pools stacked lane-folded [L, nbp1, bs, K*d])."""
     A, H, d = q.shape
@@ -500,6 +539,13 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     S = bt.shape[1] * bs
     kd = kp[bt].reshape(A, S, K, d)
     vd = vp[bt].reshape(A, S, K, d)
+    if kv_scale is not None:                    # int8 pool: dequant per token
+        sc = jax.lax.dynamic_index_in_dim(kv_scale, layer, keepdims=False)
+        sc = sc[bt][..., 0, :]                  # [A, nb_max, 2*bs]
+        sck = sc[..., :bs].reshape(A, S)
+        scv = sc[..., bs:].reshape(A, S)
+        kd = kd.astype(jnp.float32) * sck[..., None, None]
+        vd = vd.astype(jnp.float32) * scv[..., None, None]
     if K != H:
         kd = jnp.repeat(kd, rep, axis=2)
         vd = jnp.repeat(vd, rep, axis=2)
@@ -520,9 +566,10 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
 
 def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
                             atom_slot, atom_pos0, axis: str = "tp",
-                            window=None, row_pos=None):
+                            window=None, row_pos=None, kv_scale=None):
     """Tensor-parallel :func:`decode_pool_partials` (heads embarrassingly
-    parallel: q on H, pools on K, partials out on H)."""
+    parallel: q on H, pools on K, partials out on H; per-token int8 scales
+    replicated)."""
     from jax.sharding import PartitionSpec as P
 
     mesh = jax.sharding.get_abstract_mesh()
@@ -530,26 +577,38 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
             or mesh.shape[axis] <= 1:
         return decode_pool_partials(q, k_pool, v_pool, layer, block_tables,
                                     atom_slot, atom_pos0, window=window,
-                                    row_pos=row_pos)
+                                    row_pos=row_pos, kv_scale=kv_scale)
     if row_pos is None:
         row_pos = atom_pos0
 
-    def shard_fn(q, kp, vp, lay, bt, a_s, a_p, rp):
-        return decode_pool_partials(q, kp, vp, lay, bt, a_s, a_p,
-                                    window=window, row_pos=rp)
+    if kv_scale is None:
+        kv_scale = jnp.zeros((0,), jnp.float32)   # sentinel: bf16 pool
+    elif kv_scale.ndim != 4:
+        raise ValueError(
+            f"kv_scale must be [L, nb+1, 1, 2*block_size], got "
+            f"{kv_scale.shape}")
+
+    def shard_fn(q, kp, vp, lay, bt, a_s, a_p, rp, sc):
+        return decode_pool_partials(
+            q, kp, vp, lay, bt, a_s, a_p, window=window, row_pos=rp,
+            kv_scale=sc if sc.ndim == 4 else None)
 
     return jax.shard_map(
         shard_fn,
         in_specs=(P(None, axis, None), P(None, None, None, axis),
                   P(None, None, None, axis), P(), P(None, None),
-                  P(None), P(None), P(None)),
+                  P(None), P(None), P(None),
+                  P(None, None, None, None) if kv_scale.ndim == 4
+                  else P(None)),
         out_specs=(P(None, axis, None), P(None, axis), P(None, axis)),
         check_vma=False,
-    )(q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0, row_pos)
+    )(q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0, row_pos,
+      kv_scale)
 
 
 def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
-                      atom_slot, atom_pos0, atom_len, *, window, interpret):
+                      atom_slot, atom_pos0, atom_len, *, window, interpret,
+                      kv_scale=None):
     """Decode-row attention: pool partials + self token merged outside
     (flash-decode split reduction). Shapes: q/k_self/v_self [A, H|K, d];
     pools STACKED lane-folded [L, nbp1, bs, K*d], ``layer`` picks the
@@ -560,7 +619,7 @@ def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
     scale = 1.0 / math.sqrt(d)
     acc, m_k, l_k = decode_pool_partials(
         q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0,
-        window=window, interpret=interpret)
+        window=window, interpret=interpret, kv_scale=kv_scale)
 
     # merge the self token (its position == pos0: always causal-visible and
     # inside any window)
@@ -577,13 +636,19 @@ def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
     return out.astype(q.dtype)
 
 
-def _past_kernel(li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
-                 kpool, vpool, acc_ref, m_ref, l_ref,
-                 kbuf, vbuf, dsem, m_scr, l_scr, acc_scr, *,
-                 scale: float, bs: int, tq: int, K: int, rep: int,
-                 nb_max: int, NG: int, window):
+def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
+                 nb_max: int, NG: int, window, quantized: bool):
     """Prefill-past partials: one work item = G past blocks of one chunk
     atom, per-kv-head score/update loops over [R=tq*rep, G*bs] tiles."""
+    if quantized:
+        (li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
+         kpool, vpool, spool, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, sbuf, dsem, m_scr, l_scr, acc_scr) = refs
+    else:
+        (li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
+         kpool, vpool, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, dsem, m_scr, l_scr, acc_scr) = refs
+        spool = sbuf = None
     i = pl.program_id(0)
     n_items = pl.num_programs(0)
     G, DEPTH = _PAST_G, _DMA_DEPTH
@@ -593,7 +658,7 @@ def _past_kernel(li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
     g = jax.lax.rem(i, NG)
     item_dmas, item_active = _worklist_helpers(
         n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
-        kpool, vpool, kbuf, vbuf, dsem)
+        kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
 
     @pl.when(i == 0)
     def _warmup():
@@ -624,12 +689,22 @@ def _past_kernel(li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
             rowpos = (pos0 + jax.lax.broadcasted_iota(
                 jnp.int32, (R, G * bs), 0) // rep)
             keep = keep & (colpos > rowpos - window)
+        if quantized:
+            sc = sbuf[pl.ds(dst * G, G), 0]                   # [G, 2*bs]
+            sck = sc[:, :bs].reshape(1, G * bs)
+            scv = sc[:, bs:].reshape(1, G * bs)
         for kk in range(K):
             qk = q_ref[0, kk]                    # [R, d]
+            kslab = kbuf[dst, :, kk * d:(kk + 1) * d]
+            vslab = vbuf[dst, :, kk * d:(kk + 1) * d]
+            if quantized:
+                kslab = kslab.astype(qk.dtype)
+                vslab = vslab.astype(qk.dtype)
             s = jax.lax.dot_general(
-                qk, kbuf[dst, :, kk * d:(kk + 1) * d],
-                (((1,), (1,)), ((), ())),
+                qk, kslab, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [R, G*bs]
+            if quantized:
+                s = s * sck
             s = jnp.where(keep, s, NEG_INF)
             m_prev = m_scr[kk, :, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -638,9 +713,9 @@ def _past_kernel(li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
             l_scr[kk] = jnp.broadcast_to(
                 l_scr[kk, :, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
                 l_scr.shape[1:])
+            pv = (p * scv if quantized else p).astype(vslab.dtype)
             acc_scr[kk] = acc_scr[kk] * corr + jax.lax.dot_general(
-                p.astype(vbuf.dtype), vbuf[dst, :, kk * d:(kk + 1) * d],
-                (((1,), (0,)), ((), ())),
+                pv, vslab, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             m_scr[kk] = jnp.broadcast_to(m_new, m_scr.shape[1:])
 
@@ -719,9 +794,10 @@ def _self_kernel(len_ref, q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref, o_ref,
 
 def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
                        block_tables, atom_slot, atom_pos0, atom_len, tq, *,
-                       window, interpret, no_past=False):
+                       window, interpret, no_past=False, kv_scale=None):
     """Chunk-atom attention = past work-list partials + seeded self flash.
-    Pools stacked lane-folded [L, nbp1, bs, K*d]."""
+    Pools stacked lane-folded [L, nbp1, bs, K*d] (bf16, or int8 +
+    ``kv_scale``)."""
     N, H, d = q.shape
     bs, K = k_pool.shape[2], k_pool.shape[3] // d
     rep = H // K
@@ -729,6 +805,7 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
     R = tq * rep
     nb_max = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d)
+    quantized = kv_scale is not None
 
     if not no_past:
         G = _PAST_G
@@ -741,28 +818,36 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
               .reshape(A, K, R, d))
         kernel = functools.partial(
             _past_kernel, scale=scale, bs=bs, tq=tq, K=K, rep=rep,
-            nb_max=nb_max, NG=NG, window=window)
+            nb_max=nb_max, NG=NG, window=window, quantized=quantized)
+        in_specs = [
+            pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        scratch = [
+            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
+            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((_DMA_DEPTH, 3 if quantized else 2, G)),
+            pltpu.VMEM((K, R, 128), jnp.float32),
+            pltpu.VMEM((K, R, 128), jnp.float32),
+            pltpu.VMEM((K, R, d), jnp.float32),
+        ]
+        operands = [qk, k_pool, v_pool]
+        if quantized:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            scratch.insert(2, pltpu.VMEM((_DMA_DEPTH * G, 1, 2 * bs),
+                                         jnp.float32))
+            operands.append(kv_scale)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=6,
             grid=(A * NG,),
-            in_specs=[
-                pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
                 pl.BlockSpec((1, K, R, 128), lambda i, *_: (i // NG, 0, 0, 0)),
                 pl.BlockSpec((1, K, R, 128), lambda i, *_: (i // NG, 0, 0, 0)),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
-                pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
-                pltpu.SemaphoreType.DMA((_DMA_DEPTH, 2, G)),
-                pltpu.VMEM((K, R, 128), jnp.float32),
-                pltpu.VMEM((K, R, 128), jnp.float32),
-                pltpu.VMEM((K, R, d), jnp.float32),
-            ],
+            scratch_shapes=scratch,
         )
         acc_p, m_p, l_p = pl.pallas_call(
             kernel, grid_spec=grid_spec,
@@ -773,7 +858,7 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
             ],
             interpret=interpret,
         )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32),
-          pos0, lo, ng, block_tables.astype(jnp.int32), qk, k_pool, v_pool)
+          pos0, lo, ng, block_tables.astype(jnp.int32), *operands)
 
         def to_hq(x):  # [A, K, (tq, rep), c] -> [A, H=K*rep, tq, c]
             c = x.shape[-1]
@@ -791,9 +876,10 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
         bq //= 2
     tq_pad = -(-tq // bk) * bk
     pad = [(0, 0), (0, tq_pad - tq), (0, 0), (0, 0)]
-    ks4 = (jnp.pad(k_self.reshape(A, tq, K, d), pad).astype(k_pool.dtype)
+    # the atom's own KV stays in compute precision (never quantized)
+    ks4 = (jnp.pad(k_self.reshape(A, tq, K, d), pad).astype(q.dtype)
            .transpose(0, 2, 1, 3))
-    vs4 = (jnp.pad(v_self.reshape(A, tq, K, d), pad).astype(v_pool.dtype)
+    vs4 = (jnp.pad(v_self.reshape(A, tq, K, d), pad).astype(q.dtype)
            .transpose(0, 2, 1, 3))
     q4 = q.reshape(A, tq, H, d).transpose(0, 2, 1, 3)
 
@@ -838,7 +924,8 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
                            tq: int, window: Optional[int] = None,
                            interpret: Optional[bool] = None,
                            layer: Optional[jax.Array] = None,
-                           no_past: bool = False) -> jax.Array:
+                           no_past: bool = False,
+                           kv_scale: Optional[jax.Array] = None) -> jax.Array:
     """Attention over atoms of the packed token row.
 
     ``q``/``k_self``/``v_self``: [N, H|K, d] with N = n_atoms*tq; atom ``a``
@@ -878,18 +965,27 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
     if not interpret and (d % 128 or bs % 8 or (tq > 1 and bs % 128)):
         kp = jax.lax.dynamic_index_in_dim(k_pool, layer, keepdims=False)
         vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
+        kp = kp.reshape(*kp.shape[:2], K, d)
+        vp = vp.reshape(*vp.shape[:2], K, d)
+        if kv_scale is not None:                # dequant dense for fallback
+            sc = jax.lax.dynamic_index_in_dim(kv_scale, layer,
+                                              keepdims=False)[:, 0]
+            kp = kp.astype(jnp.float32) * sc[:, :bs, None, None]
+            vp = vp.astype(jnp.float32) * sc[:, bs:, None, None]
+            kp = kp.astype(q.dtype)
+            vp = vp.astype(q.dtype)
         return xla_ragged_attention(
-            q, k_self, v_self, kp.reshape(*kp.shape[:2], K, d),
-            vp.reshape(*vp.shape[:2], K, d), block_tables, atom_slot,
+            q, k_self, v_self, kp, vp, block_tables, atom_slot,
             atom_pos0, atom_len, tq, window=window)
     if tq == 1:
         return _decode_attention(q, k_self, v_self, k_pool, v_pool, layer,
                                  block_tables, atom_slot, atom_pos0,
-                                 atom_len, window=window, interpret=interpret)
+                                 atom_len, window=window, interpret=interpret,
+                                 kv_scale=kv_scale)
     return _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
                               block_tables, atom_slot, atom_pos0, atom_len,
                               tq, window=window, interpret=interpret,
-                              no_past=no_past)
+                              no_past=no_past, kv_scale=kv_scale)
 
 
 def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
@@ -900,9 +996,12 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                               axis: str = "tp",
                               window: Optional[int] = None,
                               layer: Optional[jax.Array] = None,
-                              no_past: bool = False) -> jax.Array:
+                              no_past: bool = False,
+                              kv_scale: Optional[jax.Array] = None
+                              ) -> jax.Array:
     """Tensor-parallel :func:`ragged_paged_attention`: heads embarrassingly
-    parallel, q sharded on H, the atom KV and pools on K under shard_map."""
+    parallel, q sharded on H, the atom KV and pools on K under shard_map
+    (int8 per-token scales replicated)."""
     from jax.sharding import PartitionSpec as P
 
     mesh = jax.sharding.get_abstract_mesh()
@@ -911,7 +1010,8 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
         return ragged_paged_attention(q, k_self, v_self, k_pool, v_pool,
                                       block_tables, atom_slot, atom_pos0,
                                       atom_len, tq, window=window,
-                                      layer=layer, no_past=no_past)
+                                      layer=layer, no_past=no_past,
+                                      kv_scale=kv_scale)
     tp = mesh.shape[axis]
     H = q.shape[1]
     d = q.shape[2]
@@ -927,20 +1027,30 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
     if layer is None:
         layer = jnp.zeros((), jnp.int32)
 
-    def shard_fn(q, ks, vs, kp, vp, bt, a_s, a_p, a_l, lay):
+    if kv_scale is None:
+        kv_scale = jnp.zeros((0,), jnp.float32)   # sentinel: bf16 pool
+    elif kv_scale.ndim != 4:
+        raise ValueError(
+            f"kv_scale must be [L, nb+1, 1, 2*block_size], got "
+            f"{kv_scale.shape}")
+
+    def shard_fn(q, ks, vs, kp, vp, bt, a_s, a_p, a_l, lay, sc):
         return ragged_paged_attention(q, ks, vs, kp, vp, bt, a_s, a_p, a_l,
                                       tq, window=window, layer=lay,
-                                      no_past=no_past)
+                                      no_past=no_past,
+                                      kv_scale=sc if sc.ndim == 4 else None)
 
     return jax.shard_map(
         shard_fn,
         in_specs=(P(None, axis, None), P(None, axis, None),
                   P(None, axis, None), pool_spec, pool_spec,
-                  P(None, None), P(None), P(None), P(None), P()),
+                  P(None, None), P(None), P(None), P(None), P(),
+                  P(None, None, None, None) if kv_scale.ndim == 4
+                  else P(None)),
         out_specs=P(None, axis, None),
         check_vma=False,
     )(q, k_self, v_self, k_pool, v_pool, block_tables, atom_slot, atom_pos0,
-      atom_len, layer)
+      atom_len, layer, kv_scale)
 
 
 def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
@@ -978,6 +1088,47 @@ def packed_kv_append(pool: jax.Array, new_rows: jax.Array,
     if unfolded_shape:
         out = out.reshape(unfolded_shape)
     return out
+
+
+def packed_kv_append_quant(pool: jax.Array, scale_pool: jax.Array,
+                           new_rows: jax.Array, block_tables: jax.Array,
+                           tok_slot: jax.Array, tok_pos: jax.Array,
+                           which: int,
+                           valid: Optional[jax.Array] = None):
+    """Quantize-and-append per-token KV rows into an int8 pool.
+
+    ``pool`` int8 [L, nb+1, bs, K*d]; ``scale_pool`` f32 [L, nb+1, 1,
+    2*bs]
+    holding per-token dequant scales (k rows in lanes [0, bs), v in
+    [bs, 2bs) — ``which`` 0/1 selects the half); ``new_rows`` float
+    [L, N, K, d] or [L, N, K*d]. Each row is quantized ONCE with its own
+    amax/127 scale and never requantized — per-token granularity is what
+    makes incremental block filling exact. Under tensor parallelism the
+    amax over the (sharded) head dim is an automatic GSPMD all-reduce, so
+    every shard records the same scale. Returns (pool, scale_pool)."""
+    L, nbp1, bs, KD = pool.shape
+    N = new_rows.shape[1]
+    rows = new_rows.reshape(L, N, KD).astype(jnp.float32)
+    sc = jnp.maximum(jnp.max(jnp.abs(rows), axis=-1) / 127.0, 1e-8)  # [L, N]
+    qrows = jnp.clip(jnp.round(rows / sc[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    bt_rows = block_tables[tok_slot]
+    logical = jnp.clip(tok_pos // bs, 0, bt_rows.shape[1] - 1)
+    phys = jnp.take_along_axis(bt_rows, logical[:, None], axis=1)[:, 0]
+    off = tok_pos % bs
+    li = jnp.arange(L, dtype=jnp.int32)[:, None]
+    idx = (li * nbp1 + phys[None, :]) * bs + off[None, :]
+    sidx = (li * nbp1 + phys[None, :]) * (2 * bs) + which * bs + off[None, :]
+    if valid is not None:
+        idx = jnp.where(valid[None, :], idx, L * nbp1 * bs)
+        sidx = jnp.where(valid[None, :], sidx, L * nbp1 * 2 * bs)
+    flat = pool.reshape(L * nbp1 * bs, KD)
+    flat = flat.at[idx.reshape(-1)].set(qrows.reshape(L * N, KD),
+                                        mode="drop", unique_indices=True)
+    sflat = scale_pool.reshape(L * nbp1 * 2 * bs)
+    sflat = sflat.at[sidx.reshape(-1)].set(sc.reshape(-1), mode="drop",
+                                           unique_indices=True)
+    return flat.reshape(pool.shape), sflat.reshape(scale_pool.shape)
 
 
 def xla_ragged_attention(q, k_self, v_self, k_pool, v_pool, block_tables,

@@ -378,6 +378,95 @@ def test_decode_batch_matches_sequential_puts(tiny_lm):
         assert seq_toks[u] == fused_toks[u], (u, seq_toks[u], fused_toks[u])
 
 
+def test_int8_kv_cache_matches_bf16(tiny_lm):
+    """The int8 paged pool (per-token dequant scales) must track the
+    full-precision engine through prefill, mixed continuation and the fused
+    decode loop — within quantization tolerance."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, 256, n) for n in (21, 9)]
+    cont = rng.integers(0, 256, 5)
+    engs = {}
+    outs = {}
+    for mode in ("bf16", "int8"):
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=64, block_size=8, kv_dtype=mode)
+        outs[mode] = [eng.put([1, 2], prompts)]          # whole prefill
+        outs[mode].append(eng.put([1, 2], [np.array([3]), np.array([4])]))
+        outs[mode].append(eng.put([1, 2], [cont, np.array([7])]))  # w/ past
+        engs[mode] = eng
+    for step_a, step_b in zip(outs["bf16"], outs["int8"]):
+        for u in (1, 2):
+            a = np.asarray(step_a[u], np.float32)
+            b = np.asarray(step_b[u], np.float32)
+            # int8 KV error on logits: small relative to logit scale
+            assert np.abs(a - b).max() < 0.15 * max(np.abs(a).max(), 1.0), \
+                (u, np.abs(a - b).max())
+    # fused decode loop runs on the int8 pool
+    out = engs["int8"].decode_batch([1, 2], [1, 2], steps=4)
+    assert all(len(out[u]) == 4 for u in (1, 2))
+
+
+def test_decode_batch_sampling(tiny_lm):
+    """Sampling inside the fused loop (reference FastGen serves sampled
+    tokens): deterministic per seed, greedy at temperature 0, and the
+    first sampled token's empirical distribution matches direct
+    sample_token draws from the same logits."""
+    from deepspeed_tpu.inference.engine import sample_token
+
+    model, params = tiny_lm
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 256, 6)
+    B = 8
+
+    eng = InferenceEngineV2(model, params=params, max_sequences=B,
+                            max_seq_len=64, block_size=8)
+    uids = list(range(B))
+    r = eng.put(uids, [prompt] * B)        # identical context per row
+    logits = np.asarray(r[0], np.float32)  # [V] — same for every row
+    first = int(np.argmax(logits))
+
+    # determinism + greedy equivalence
+    s1 = eng.decode_batch(uids, [first] * B, steps=3, temperature=0.8,
+                          top_k=16, seed=7)
+    eng.flush(uids)
+    eng.put(uids, [prompt] * B)
+    s2 = eng.decode_batch(uids, [first] * B, steps=3, temperature=0.8,
+                          top_k=16, seed=7)
+    for u in uids:
+        assert list(s1[u]) == list(s2[u]), "same seed must reproduce"
+
+    # distribution: first sampled token across rows x seeds vs direct
+    # sample_token draws from the same logits, under top_k=8 (bounded
+    # support makes small-sample statistics meaningful)
+    draws = []
+    for seed in range(6):
+        eng.flush(uids)
+        eng.put(uids, [prompt] * B)
+        out = eng.decode_batch(uids, [first] * B, steps=1, temperature=0.7,
+                               top_k=8, seed=seed)
+        draws += [int(out[u][0]) for u in uids]
+    # direct draws from the same next-token logits (the row after `first`
+    # is appended — recompute via a put of `first`)
+    eng.flush(uids)
+    r2 = eng.put([0], [np.concatenate([prompt, [first]])])
+    base_logits = np.asarray(r2[0], np.float32)
+    top8 = set(np.argsort(base_logits)[-8:].tolist())
+    assert set(draws) <= top8, (set(draws) - top8,
+                                "sampled outside the top-k support")
+    ref_draws = []
+    for seed in range(96):
+        tok = sample_token(jnp_f(base_logits)[None], 0.7, 8,
+                           jax.random.key(1000 + seed))
+        ref_draws.append(int(tok[0]))
+    import collections
+    ca = collections.Counter(draws)
+    cb = collections.Counter(ref_draws)
+    tvd = 0.5 * sum(abs(ca[t] / len(draws) - cb[t] / len(ref_draws))
+                    for t in top8 | set(ca) | set(cb))
+    assert tvd < 0.45, (tvd, ca, cb)
+
+
 class TestRaggedKernels:
     """Numeric parity of the atom-based serving kernels (reference
     v2/kernels/ragged_ops/blocked_flash + atom_builder) against the dense
