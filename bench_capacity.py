@@ -1,0 +1,107 @@
+"""ZeRO-Infinity capacity probe — largest model that fine-tunes on ONE chip.
+
+BASELINE.md config #3 ("Llama-3-70B ZeRO-Infinity fits and fine-tunes on
+v5e-8; max params/chip tracked") needs a measured per-chip datapoint:
+binary-search model size with ZeRO-2 + NVMe-offloaded optimizer state
+(fp32 masters + Adam moments live in swap files via ``csrc/aio``; the chip
+holds bf16 params, grads, and remat'd activations). Each candidate runs in
+a SUBPROCESS so an HBM OOM kills only the trial.
+
+Standalone and opt-in (minutes of runtime): prints one JSON line; the
+measured result is recorded in BASELINE.md and bench.py's extra.offload.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+CHILD = r"""
+import json, sys, time
+import numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, TransformerConfig
+
+hidden, layers = int(sys.argv[1]), int(sys.argv[2])
+cfg = TransformerConfig(vocab_size=32000, hidden_size=hidden,
+                        num_layers=layers, num_heads=hidden // 128,
+                        num_kv_heads=max(1, hidden // 256),
+                        max_seq_len=1024, arch="llama",
+                        remat_policy="full")
+model = TransformerLM(cfg)
+engine, *_ = ds.initialize(model=model, config={
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-5}},
+    "zero_optimization": {
+        "stage": 2,
+        "offload_optimizer": {"device": "nvme",
+                              "nvme_path": "/tmp/dstpu_capacity_swap"},
+    },
+    "steps_per_print": 10 ** 9,
+})
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(0, cfg.vocab_size, (1, 1024))
+         .astype(np.int32)}
+
+def one_step():
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    return float(loss)
+
+print("compiling + first step...", file=sys.stderr, flush=True)
+l0 = one_step()                      # compile + first step
+print(f"first step done loss={l0}", file=sys.stderr, flush=True)
+t0 = time.perf_counter()
+l1 = one_step()
+dt = time.perf_counter() - t0
+assert np.isfinite(l1), l1
+print(json.dumps({"params_b": cfg.num_params_estimate() / 1e9,
+                  "step_s": round(dt, 2), "loss0": round(l0, 3),
+                  "loss1": round(l1, 3)}))
+"""
+
+
+def try_size(hidden: int, layers: int, timeout: int = 2700):
+    """One candidate in a subprocess (an HBM OOM kills only the trial).
+    NOTE: on the tunneled dev runtime host<->device transfers run at
+    ~100 MB/s, so offload steps on billion-param models take minutes —
+    the capacity answer (fits / does not fit) is unaffected."""
+    with open(f"/tmp/capacity_trial_{hidden}x{layers}.log", "w") as logf:
+        try:
+            p = subprocess.run([sys.executable, "-c", CHILD, str(hidden),
+                                str(layers)], stdout=subprocess.PIPE,
+                               stderr=logf, text=True, timeout=timeout,
+                               cwd="/root/repo")
+        except subprocess.TimeoutExpired:
+            return {"error": f"timeout after {timeout}s"}
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except Exception:
+            continue
+    return {"error": "no output (see trial log)"}
+
+
+def main():
+    # ladder of (hidden, layers) with rising param counts; stop at first OOM
+    ladder = [(2048, 16), (2560, 20), (3072, 24), (3584, 28), (4096, 32),
+              (4608, 36)]
+    results = []
+    best = None
+    for hidden, layers in ladder:
+        t0 = time.time()
+        r = try_size(hidden, layers)
+        r.update({"hidden": hidden, "layers": layers,
+                  "wall_s": round(time.time() - t0, 1)})
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr)
+        if "error" in r:
+            break
+        best = r
+    print(json.dumps({"metric": "zero_infinity_capacity_per_chip",
+                      "best": best, "trials": results}))
+
+
+if __name__ == "__main__":
+    main()

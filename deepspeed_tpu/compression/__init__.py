@@ -7,5 +7,8 @@ pytree + straight-through-estimator wrappers for QAT.
 """
 
 from deepspeed_tpu.compression.compress import (  # noqa: F401
-    init_compression, prune_magnitude, quantize_weights_ptq, ste_quantize,
+    CompressionScheduler, apply_head_mask, apply_row_mask,
+    apply_channel_mask, channel_prune_indices, clean_heads, clean_rows, head_prune_indices,
+    init_compression, prune_magnitude, quantize_weights_ptq,
+    row_prune_indices, ste_quantize,
 )

@@ -66,6 +66,274 @@ def prune_magnitude(params: Any, sparsity: float,
     return jax.tree_util.tree_map_with_path(one, params)
 
 
+# ---------------------------------------------------------------------------
+# structured pruning (basic_layer.py head/row/channel pruning, functional)
+#
+# Scores and masks are computed PER LAYER of the stacked [L, ...] parameter
+# leaves with a uniform keep-count, so the scanned-layer structure (and its
+# pp/tp shardings) survives both the masked-training phase and the physical
+# ``redundancy_clean`` slice. GQA attention is pruned at KV-GROUP granularity
+# (a kv head plus its query-head group) so the head/kv-head ratio stays
+# intact.
+# ---------------------------------------------------------------------------
+
+
+def head_prune_indices(params: Any, cfg, ratio: float) -> jax.Array:
+    """Per-layer kept kv-group indices [L, K_keep] (sorted), scored by the
+    L1 mass of each group's attention-output rows (HEAD_PRUNING parity:
+    reference scores the attention output matrix)."""
+    wo = params["layers"]["attn"]["wo"]                  # [L, H*d, D]
+    L = wo.shape[0]
+    K, rep, d = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads, \
+        cfg.head_dim
+    scores = jnp.sum(jnp.abs(wo.reshape(L, K, rep * d, -1)), axis=(2, 3))
+    keep = K - int(K * ratio)
+    if keep < 1:
+        raise ValueError(f"head pruning ratio {ratio} leaves no kv groups")
+    _, idx = jax.lax.top_k(scores, keep)                 # [L, keep]
+    return jnp.sort(idx, axis=-1)
+
+
+def apply_head_mask(params: Any, cfg, keep_idx: jax.Array) -> Any:
+    """Zero the pruned kv-groups' slices of wq/wk/wv (+biases) and wo rows —
+    training continues with masked weights; contributions are exactly 0."""
+    K, rep, d = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads, \
+        cfg.head_dim
+    L = keep_idx.shape[0]
+    kept = jnp.zeros((L, K), bool)
+    kept = kept.at[jnp.arange(L)[:, None], keep_idx].set(True)  # [L, K]
+
+    def mask_cols(w, per_group):                         # [L, D, K*per]
+        m = jnp.repeat(kept, per_group, axis=1)[:, None, :]
+        return (w * m).astype(w.dtype)
+
+    def mask_rows(w, per_group):                         # [L, K*per, D]
+        m = jnp.repeat(kept, per_group, axis=1)[:, :, None]
+        return (w * m).astype(w.dtype)
+
+    attn = dict(params["layers"]["attn"])
+    attn["wq"] = mask_cols(attn["wq"], rep * d)
+    attn["wk"] = mask_cols(attn["wk"], d)
+    attn["wv"] = mask_cols(attn["wv"], d)
+    attn["wo"] = mask_rows(attn["wo"], rep * d)
+    for b, per in (("bq", rep * d), ("bk", d), ("bv", d)):
+        if b in attn:
+            attn[b] = (attn[b] * jnp.repeat(kept, per, axis=1)).astype(
+                attn[b].dtype)
+    layers = dict(params["layers"])
+    layers["attn"] = attn
+    p = dict(params)
+    p["layers"] = layers
+    return p
+
+
+def clean_heads(params: Any, cfg, keep_idx: jax.Array):
+    """Physically slice the pruned kv groups out (redundancy_clean parity):
+    returns (smaller params, updated cfg) — the served model shrinks."""
+    import dataclasses
+
+    K, rep, d = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads, \
+        cfg.head_dim
+    L, keep = keep_idx.shape
+
+    def take_cols(w, per_group):                         # [L, D, K*per]
+        wk = w.reshape(L, w.shape[1], K, per_group)
+        out = jnp.take_along_axis(wk, keep_idx[:, None, :, None], axis=2)
+        return out.reshape(L, w.shape[1], keep * per_group)
+
+    def take_rows(w, per_group):                         # [L, K*per, D]
+        wk = w.reshape(L, K, per_group, w.shape[-1])
+        out = jnp.take_along_axis(wk, keep_idx[:, :, None, None], axis=1)
+        return out.reshape(L, keep * per_group, w.shape[-1])
+
+    attn = dict(params["layers"]["attn"])
+    attn["wq"] = take_cols(attn["wq"], rep * d)
+    attn["wk"] = take_cols(attn["wk"], d)
+    attn["wv"] = take_cols(attn["wv"], d)
+    attn["wo"] = take_rows(attn["wo"], rep * d)
+    for b, per in (("bq", rep * d), ("bk", d), ("bv", d)):
+        if b in attn:
+            bk = attn[b].reshape(L, K, per)
+            attn[b] = jnp.take_along_axis(
+                bk, keep_idx[:, :, None], axis=1).reshape(L, keep * per)
+    layers = dict(params["layers"])
+    layers["attn"] = attn
+    out = dict(params)
+    out["layers"] = layers
+    new_cfg = dataclasses.replace(cfg, num_kv_heads=keep,
+                                  num_heads=keep * rep,
+                                  head_dim_override=cfg.head_dim)
+    return out, new_cfg
+
+
+def _dense_mlp_only(params, what):
+    wd = params["layers"]["mlp"]["w_down"]
+    if wd.ndim != 3:
+        raise NotImplementedError(
+            f"{what} supports dense MLPs ([L, F, D] leaves); MoE expert "
+            f"stacks ({wd.shape}) are not supported")
+    return wd
+
+
+def row_prune_indices(params: Any, cfg, ratio: float) -> jax.Array:
+    """Per-layer kept FFN-neuron indices [L, F_keep] (ROW_PRUNING parity:
+    rows of the down projection, scored by L1)."""
+    wd = _dense_mlp_only(params, "row pruning")          # [L, F, D]
+    L, F = wd.shape[0], wd.shape[1]
+    scores = jnp.sum(jnp.abs(wd), axis=-1)               # [L, F]
+    keep = F - int(F * ratio)
+    if keep < 1:
+        raise ValueError(f"row pruning ratio {ratio} leaves no neurons")
+    _, idx = jax.lax.top_k(scores, keep)
+    return jnp.sort(idx, axis=-1)
+
+
+def apply_row_mask(params: Any, cfg, keep_idx: jax.Array) -> Any:
+    wd = params["layers"]["mlp"]["w_down"]
+    L, F = wd.shape[0], wd.shape[1]
+    kept = jnp.zeros((L, F), bool)
+    kept = kept.at[jnp.arange(L)[:, None], keep_idx].set(True)
+    mlp = dict(params["layers"]["mlp"])
+    mlp["w_down"] = (mlp["w_down"] * kept[:, :, None]).astype(wd.dtype)
+    for k in ("w_up", "w_gate"):
+        if k in mlp:
+            mlp[k] = (mlp[k] * kept[:, None, :]).astype(mlp[k].dtype)
+    if "b_up" in mlp:
+        mlp["b_up"] = (mlp["b_up"] * kept).astype(mlp["b_up"].dtype)
+    layers = dict(params["layers"])
+    layers["mlp"] = mlp
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def clean_rows(params: Any, cfg, keep_idx: jax.Array):
+    """Physically slice pruned FFN neurons out; returns (params, cfg)."""
+    import dataclasses
+
+    mlp = dict(params["layers"]["mlp"])
+    keep = keep_idx.shape[1]
+    mlp["w_down"] = jnp.take_along_axis(mlp["w_down"],
+                                        keep_idx[:, :, None], axis=1)
+    for k in ("w_up", "w_gate"):
+        if k in mlp:
+            mlp[k] = jnp.take_along_axis(mlp[k], keep_idx[:, None, :],
+                                         axis=2)
+    if "b_up" in mlp:
+        mlp["b_up"] = jnp.take_along_axis(mlp["b_up"], keep_idx, axis=1)
+    layers = dict(params["layers"])
+    layers["mlp"] = mlp
+    out = dict(params)
+    out["layers"] = layers
+    return out, dataclasses.replace(cfg, intermediate_size=keep)
+
+
+def channel_prune_indices(params: Any, cfg, ratio: float) -> jax.Array:
+    """Per-layer kept input-channel indices [L, D_keep] of the MLP up
+    projections, scored by L1 (CHANNEL_PRUNING parity)."""
+    _dense_mlp_only(params, "channel pruning")
+    wu = params["layers"]["mlp"]["w_up"]                  # [L, D, F]
+    scores = jnp.sum(jnp.abs(wu), axis=-1)                # [L, D]
+    D = wu.shape[1]
+    keep = D - int(D * ratio)
+    if keep < 1:
+        raise ValueError(f"channel pruning ratio {ratio} leaves no channels")
+    _, idx = jax.lax.top_k(scores, keep)
+    return jnp.sort(idx, axis=-1)
+
+
+def apply_channel_mask(params: Any, cfg, keep_idx: jax.Array) -> Any:
+    """Mask the pruned MLP input channels. The hidden/residual dim is
+    globally coupled (norms, attn, embeddings), so channel pruning is
+    mask-only — the clean step cannot shrink the residual width without
+    retraining; documented limitation shared with the reference's
+    conv-centric clean."""
+    wu = params["layers"]["mlp"]["w_up"]
+    L, D = wu.shape[0], wu.shape[1]
+    kept = jnp.zeros((L, D), bool)
+    kept = kept.at[jnp.arange(L)[:, None], keep_idx].set(True)
+    mlp = dict(params["layers"]["mlp"])
+    for k in ("w_up", "w_gate"):
+        if k in mlp:
+            mlp[k] = (mlp[k] * kept[:, :, None]).astype(mlp[k].dtype)
+    layers = dict(params["layers"])
+    layers["mlp"] = mlp
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+class CompressionScheduler:
+    """Staged compression (reference ``compression/scheduler.py``): each
+    technique activates at its ``schedule_offset`` step; pruning masks are
+    (re)applied every step afterwards so optimizer updates cannot resurrect
+    pruned weights. Drive it from the training loop::
+
+        sched = CompressionScheduler(model.cfg, compression_config)
+        for step in ...:
+            engine.train_batch(batch)
+            engine.params = sched.step(engine.params, step)
+
+    ``redundancy_clean(params)`` afterwards slices pruned structures out
+    (smaller served model)."""
+
+    def __init__(self, model_cfg, config: Dict[str, Any]):
+        self.cfg = model_cfg
+        self.config = config or {}
+        self.indices: Dict[str, Any] = {}
+
+    def _tech(self, name):
+        t = self.config.get(name, {})
+        return t if t.get("enabled") else None
+
+    def _active(self, t, step):
+        return t is not None and step >= int(t.get("schedule_offset", 0))
+
+    def step(self, params: Any, global_step: int) -> Any:
+        hp = self._tech("head_pruning")
+        if self._active(hp, global_step):
+            if "head" not in self.indices:
+                self.indices["head"] = head_prune_indices(
+                    params, self.cfg, float(hp.get("ratio", 0.5)))
+            params = apply_head_mask(params, self.cfg, self.indices["head"])
+        rp = self._tech("row_pruning")
+        if self._active(rp, global_step):
+            if "row" not in self.indices:
+                self.indices["row"] = row_prune_indices(
+                    params, self.cfg, float(rp.get("ratio", 0.5)))
+            params = apply_row_mask(params, self.cfg, self.indices["row"])
+        cp = self._tech("channel_pruning")
+        if self._active(cp, global_step):
+            if "channel" not in self.indices:
+                self.indices["channel"] = channel_prune_indices(
+                    params, self.cfg, float(cp.get("ratio", 0.25)))
+            params = apply_channel_mask(params, self.cfg,
+                                        self.indices["channel"])
+        sp = self._tech("sparse_pruning")
+        if self._active(sp, global_step):
+            params = prune_magnitude(params, float(sp.get("sparsity", 0.5)))
+        wq = self._tech("weight_quantization")
+        if self._active(wq, global_step) and "wq_applied" not in self.indices:
+            # ONE-SHOT PTQ at the offset: re-quantizing the live master
+            # weights every step would round away optimizer updates smaller
+            # than the quantization step and stall training. For true QAT,
+            # quantize in the FORWARD with straight-through gradients
+            # instead (cfg.act_quant_bits / ste_quantize).
+            params = quantize_weights_ptq(params,
+                                          bits=int(wq.get("bits", 8)))
+            self.indices["wq_applied"] = True
+        return params
+
+    def redundancy_clean(self, params: Any):
+        """Slice pruned structures out; returns (smaller params, new cfg)."""
+        cfg = self.cfg
+        if "head" in self.indices:
+            params, cfg = clean_heads(params, cfg, self.indices["head"])
+        if "row" in self.indices:
+            params, cfg = clean_rows(params, cfg, self.indices["row"])
+        return params, cfg
+
+
 def init_compression(engine_or_params, compression_config: Optional[Dict] = None):
     """``init_compression`` parity: apply configured transforms to a params tree
     (or an engine's params in place)."""

@@ -331,6 +331,12 @@ class PipelineConfig(DSTpuConfigModel):
     # auto = 1f1b, falling back to gpipe for ZeRO stage >= 2 (1f1b keeps the
     # reference's stage <= 1 restriction; gpipe composes with ZeRO-3)
     pipe_schedule: str = "auto"  # auto|1f1b|gpipe
+    # 1F1B backward policy: False recomputes each stage forward from the
+    # saved stage input (cheapest memory); True keeps per-layer inputs of
+    # the <= 2*pp-1 in-flight microbatches for per-block recompute
+    # live-ranges (see runtime/pipe.py for the documented GSPMD limitation
+    # vs the reference's zero-recompute backward)
+    pipe_save_activations: bool = False
 
 
 class CurriculumLearningConfig(DSTpuConfigModel):

@@ -72,6 +72,9 @@ class TransformerConfig:
     remat_policy: str = "none"     # runtime.activation_checkpointing.POLICIES
     scan_layers: bool = True
     attention_impl: str = "auto"   # auto|xla|flash|ring
+    # compression_training activation_quantization: fake-quantize MLP block
+    # inputs with straight-through gradients when set (e.g. 8)
+    act_quant_bits: Optional[int] = None
     z_loss: float = 0.0
     # >1: compute the CE loss in T/loss_tiling sequence chunks without ever
     # materializing the [B, T, V] fp32 logits (ALST TiledFusedLogitsLoss,
@@ -110,14 +113,20 @@ class TransformerConfig:
             # round to MXU-friendly multiple of 128
             inter = max(128, ((inter + 127) // 128) * 128)
             object.__setattr__(self, "intermediate_size", inter)
-        assert self.hidden_size % self.num_heads == 0
+        if self.head_dim_override is None:
+            assert self.hidden_size % self.num_heads == 0
         assert self.num_heads % self.num_kv_heads == 0
         if self.parallel_shared_norm:
             assert self.parallel_block, "shared norm requires parallel_block"
 
+    # set when structured head pruning shrinks num_heads (head_dim is
+    # otherwise derived as hidden_size // num_heads, which would silently
+    # change under a reduced head count)
+    head_dim_override: Optional[int] = None
+
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return self.head_dim_override or self.hidden_size // self.num_heads
 
     @property
     def rope_dim(self) -> int:
@@ -364,6 +373,13 @@ def _decode_block(h: jax.Array, wc: Params, cfg: TransformerConfig,
 
 
 def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
+    if cfg.act_quant_bits:
+        # activation quantization (compression_training
+        # activation_quantization parity): fake-quantize the block input
+        # with straight-through gradients
+        from deepspeed_tpu.compression.compress import ste_quantize
+
+        x = ste_quantize(x, bits=cfg.act_quant_bits)
     if cfg.activation == "swiglu":
         h = jax.nn.silu(x @ w["w_gate"]) * (x @ w["w_up"])
     else:

@@ -39,13 +39,46 @@ from deepspeed_tpu.models.transformer import (
 )
 
 
+def _vp_lm_loss(cfg, logits_local: jax.Array, batch: Dict[str, Any],
+                off: jax.Array) -> jax.Array:
+    """``lm_loss`` semantics over a vocab dim sharded across the manual
+    ``pp`` axis: logsumexp via pmax/psum, the gold logit via in-range
+    masking + psum. ``logits_local`` [.., Vs] is this stage's slice starting
+    at global vocab offset ``off``."""
+    ids = batch["input_ids"]
+    Vs = logits_local.shape[-1]
+    if "labels" in batch:
+        labels, lmask = batch["labels"], (batch["labels"] >= 0)
+        labels = jnp.maximum(labels, 0)
+        lg = logits_local
+    else:
+        labels, lg = ids[:, 1:], logits_local[:, :-1]
+        lmask = (batch["attention_mask"][:, 1:].astype(bool)
+                 if "attention_mask" in batch else jnp.ones_like(labels, bool))
+    lg = lg.astype(jnp.float32)
+    # stop_gradient BEFORE pmax: pmax has no differentiation rule, and the
+    # max only stabilizes the exp (its gradient cancels anyway)
+    m = lax.pmax(jax.lax.stop_gradient(jnp.max(lg, axis=-1)), "pp")
+    se = lax.psum(jnp.sum(jnp.exp(lg - m[..., None]), axis=-1), "pp")
+    logz = m + jnp.log(se)
+    loc = jnp.clip(labels - off, 0, Vs - 1)
+    gold_loc = jnp.take_along_axis(lg, loc[..., None], axis=-1)[..., 0]
+    in_rng = (labels >= off) & (labels < off + Vs)
+    gold = lax.psum(jnp.where(in_rng, gold_loc, 0.0), "pp")
+    nll = logz - gold
+    if cfg.z_loss > 0.0:
+        nll = nll + cfg.z_loss * jnp.square(logz)
+    denom = jnp.maximum(lmask.sum(), 1)
+    return jnp.where(lmask, nll, 0.0).sum() / denom
+
+
 class PipelineModule:
     """ModelSpec wrapper running the inner model's layer stack as a pipeline."""
 
     def __init__(self, model: TransformerLM, num_stages: int,
                  micro_batches: Optional[int] = None,
                  activation_checkpointing: bool = True,
-                 schedule: str = "1f1b"):
+                 schedule: str = "1f1b", save_activations: bool = False):
         if model.cfg.num_layers % num_stages != 0:
             raise ValueError(f"num_layers={model.cfg.num_layers} not divisible by "
                              f"pipeline stages={num_stages}")
@@ -65,13 +98,31 @@ class PipelineModule:
         self.micro_batches = micro_batches or num_stages
         self.remat = activation_checkpointing
         self.schedule = schedule
+        # 1F1B backward policy (reference pipe/engine.py:811 saves full
+        # activations; both policies here still recompute inside the
+        # backward — see the limitation note below):
+        # * save_activations=False (default): the backward re-runs the WHOLE
+        #   stage forward from the saved stage input via one vjp (recompute
+        #   live-range = the full stage). Cheapest memory.
+        # * save_activations=True: per-layer INPUTS of each in-flight
+        #   microbatch are kept in a rolling ring (bounded by the in-flight
+        #   count 2*pp-1, NOT by M) and the backward vjp's each block from
+        #   its own saved input — per-BLOCK recompute live-ranges and no
+        #   re-run of the embedding, at ~layers_per_stage x the ring memory.
+        # LIMITATION (documented): the reference's true cost model (1x fwd +
+        # bwd, zero recompute) needs the full VJP residuals of each
+        # in-flight microbatch carried as data. In a single-program GSPMD
+        # pipeline the fwd-to-bwd delay is stage-varying, so residuals must
+        # round-trip a one-hot-indexed ring; JAX only exposes them as vjp
+        # closures (closure_convert hoists the params into the residual
+        # list, which would ring-buffer the weights themselves). Per-stage
+        # programs (MPMD) — which this SPMD design deliberately avoids —
+        # are what make the reference's scheme expressible.
+        self.save_activations = save_activations
         if schedule == "1f1b":
             # the engine differentiates loss_fn; a hand-scheduled 1F1B
             # interleaves fwd/bwd itself, so it exposes loss_and_grad and
-            # the engine uses it instead of jax.value_and_grad. Its backward
-            # recomputes each stage forward from the saved stage input by
-            # construction, so activation_checkpointing has no effect here
-            # (it tunes the GPipe autodiff path only).
+            # the engine uses it instead of jax.value_and_grad.
             self.loss_and_grad = self._loss_and_grad_1f1b
 
     def init(self, rng):
@@ -99,17 +150,33 @@ class PipelineModule:
         if mesh is None or mesh.empty or "pp" not in mesh.axis_names:
             raise RuntimeError("PipelineModule.loss_fn requires a mesh context with a "
                                "'pp' axis (run under the engine)")
+        n_pp = mesh.shape["pp"]
         param_specs = jax.tree_util.tree_map(
             lambda _: P(), params, is_leaf=lambda x: x is None)
         param_specs["layers"] = jax.tree_util.tree_map(
             lambda _: P("pp"), params["layers"])
         batch_specs = jax.tree_util.tree_map(lambda _: P(), batch)
-        fn = jax.shard_map(self._local_loss, mesh=mesh,
-                           in_specs=(param_specs, batch_specs),
+        # stage-owned LM head (reference pipe/module.py:698): the head matmul
+        # is the pipeline's big replicated cost (pp x V-dim FLOPs). The head
+        # weight enters the region vocab-sharded over pp and each stage
+        # computes its logits slice of the (broadcast) last-stage
+        # activations — 1x aggregate head FLOPs. Derived OUTSIDE shard_map
+        # so tied-embedding gradients flow back through the transpose
+        # automatically. (The 1F1B schedule cannot do this: its stages run
+        # DIFFERENT microbatches at the same tick, so the vocab-parallel
+        # loss collectives would mix microbatches — documented limitation.)
+        vp = (self.cfg.vocab_size % n_pp == 0) and n_pp > 1
+        head = (params["embed"]["tokens"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+        if vp:
+            head = jax.lax.with_sharding_constraint(head, P(None, "pp"))
+        fn = jax.shard_map(partial(self._local_loss, vp=vp), mesh=mesh,
+                           in_specs=(param_specs, batch_specs,
+                                     P(None, "pp") if vp else P()),
                            out_specs=P(), axis_names={"pp"})
-        return fn(params, batch)
+        return fn(params, batch, head)
 
-    def _local_loss(self, params, batch):
+    def _local_loss(self, params, batch, head_w, *, vp=False):
         cfg = self.cfg
         if (jnp.dtype(cfg.dtype) == jnp.bfloat16
                 and jax.default_backend() == "cpu"):
@@ -187,10 +254,19 @@ class PipelineModule:
         # region — pin the sequence dim unsharded for the loss head.
         h = lax.with_sharding_constraint(outputs.reshape(B, T, -1),
                                          P(U, None, None))
+        if vp:
+            # broadcast the LAST stage's activations ([B,T,D], cheap next to
+            # a [B,T,V] logits buffer), then every stage computes only ITS
+            # vocab slice of the head — aggregate head FLOPs drop from
+            # pp x to 1x. Collectives here are microbatch-consistent: the
+            # schedule loop is done and all stages hold the same h.
+            h = lax.psum(jnp.where(idx == n - 1, h, 0), "pp")
+            h = _norm(h, params["final_norm"], cfg.norm, cfg.norm_eps)
+            logits_local = h @ head_w.astype(dt)        # [B, T, V/pp]
+            Vs = logits_local.shape[-1]
+            return _vp_lm_loss(cfg, logits_local, batch, idx * Vs)
         h = _norm(h, params["final_norm"], cfg.norm, cfg.norm_eps)
-        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        logits = h @ head.astype(dt)
+        logits = h @ head_w.astype(dt)
         loss = lm_loss(cfg, logits, batch)
         # only the last stage holds real outputs; broadcast its loss
         return lax.psum(jnp.where(idx == n - 1, loss, 0.0), "pp")
@@ -222,6 +298,11 @@ class PipelineModule:
         if mesh is None or mesh.empty or "pp" not in mesh.axis_names:
             raise RuntimeError("PipelineModule loss requires a mesh context "
                                "with a 'pp' axis (run under the engine)")
+        # NOTE: the GPipe path's stage-owned (vocab-parallel) head cannot be
+        # used here — 1F1B stages run DIFFERENT microbatches at the same
+        # tick, so any cross-stage collective inside the per-microbatch
+        # head/embedding would mix microbatches. The head stays replicated
+        # over pp (a known cost of the SPMD 1F1B schedule).
         param_specs = jax.tree_util.tree_map(
             lambda _: P(), params, is_leaf=lambda x: x is None)
         param_specs["layers"] = jax.tree_util.tree_map(
@@ -333,7 +414,14 @@ class PipelineModule:
             return lm_loss(cfg, logits, bm)
 
         BUF = 2 * n  # rolling stage-input buffer: in-flight <= 2(pp-1)+1
-        bufs = jnp.zeros((BUF + 1, mb, T, cfg.hidden_size), dt)
+        Ln = cfg.num_layers // n
+        save = self.save_activations
+        if save:
+            # per-layer stage inputs + stage outputs of in-flight microbatches
+            acts = jnp.zeros((BUF + 1, Ln, mb, T, cfg.hidden_size), dt)
+            outs = jnp.zeros((BUF + 1, mb, T, cfg.hidden_size), dt)
+        else:
+            bufs = jnp.zeros((BUF + 1, mb, T, cfg.hidden_size), dt)
         fwd_state = jnp.zeros((mb, T, cfg.hidden_size), dt)
         cot_state = jnp.zeros((mb, T, cfg.hidden_size), jnp.float32)
         g_layers = jax.tree_util.tree_map(
@@ -343,6 +431,50 @@ class PipelineModule:
         loss_sum = jnp.zeros((), jnp.float32)
         perm_f = [(i, (i + 1) % n) for i in range(n)]
         perm_b = [(i, (i - 1) % n) for i in range(n)]
+
+        def stage_fwd_saving(layers_local, h):
+            def body(carry, layer_w):
+                y, _aux = transformer_block(carry, layer_w, cfg, freqs,
+                                            attn_fn)
+                return y, carry          # stash each layer's INPUT
+
+            h, xs = lax.scan(body, h, layers_local)
+            return h, xs                 # xs: [Ln, mb, T, D]
+
+        def bwd_saved(layers_p, rest_p, xs_saved, out_saved, m, cot):
+            """Backward from saved per-layer inputs: per-block recompute
+            live-ranges, embedding not re-run (see the policy note in
+            ``__init__`` for what this does and does not save). Same
+            uniform-program head/seed/masking scheme as ``bwd``."""
+            lossm, (g_rest_head, g_out) = jax.value_and_grad(
+                lambda rp, o: head_loss(rp, o, m), argnums=(0, 1))(
+                    rest_p, out_saved)
+            is_last = (idx == n - 1).astype(jnp.float32)
+            cot_eff = jnp.where(idx == n - 1,
+                                g_out.astype(jnp.float32) * (scale / M), cot)
+
+            def layer_bwd(cot_f32, inp):
+                layer_w, x_l = inp
+                _, vjp_l = jax.vjp(
+                    lambda w, x: transformer_block(x, w, cfg, freqs,
+                                                   attn_fn)[0],
+                    layer_w, x_l)
+                gw, gx = vjp_l(cot_f32.astype(dt))
+                return gx.astype(jnp.float32), gw
+
+            cot0, gl = lax.scan(layer_bwd, cot_eff, (layers_p, xs_saved),
+                                reverse=True)
+            # stage 0 routes the remaining cotangent into the embedding;
+            # other stages send it upstream
+            _, vjp_e = jax.vjp(lambda rp: embed_mb(rp, m), rest_p)
+            (g_rest_emb,) = vjp_e(
+                jnp.where(idx == 0, cot0, 0).astype(dt))
+            gr = jax.tree_util.tree_map(
+                lambda a, b: a.astype(jnp.float32)
+                + is_last * (scale / M) * b.astype(jnp.float32),
+                g_rest_emb, g_rest_head)
+            gh = jnp.where(idx == 0, 0.0, cot0)
+            return (None, lossm), (gl, gr, gh)
 
         def bwd(layers_p, rest_p, h_recv, m, cot):
             """One uniform backward program for every stage (branching with
@@ -377,13 +509,20 @@ class PipelineModule:
             m_f = j - idx
             f_valid = jnp.logical_and(m_f >= 0, m_f < M)
             m_fc = jnp.clip(m_f, 0, M - 1)
-            out = tick_fwd(params["layers"], rest, fwd_state, m_fc)
             slot = jnp.where(f_valid, m_fc % BUF, BUF)  # BUF = trash slot
             # one-hot select instead of a device-varying dynamic-update:
             # GSPMD check-fails on varying-offset scatters over operands that
             # are simultaneously auto-sharded on other dims
             sel = (jnp.arange(BUF + 1) == slot)[:, None, None, None]
-            bufs = jnp.where(sel, fwd_state[None], bufs)
+            if save:
+                x_m = embed_mb(rest, m_fc)
+                h_in = jnp.where(idx == 0, x_m, fwd_state)
+                out, xs = stage_fwd_saving(params["layers"], h_in)
+                acts = jnp.where(sel[:, None], xs[None], acts)
+                outs = jnp.where(sel, out[None], outs)
+            else:
+                out = tick_fwd(params["layers"], rest, fwd_state, m_fc)
+                bufs = jnp.where(sel, fwd_state[None], bufs)
             fwd_next = lax.ppermute(
                 jnp.where(f_valid, out, 0), "pp", perm_f)
             # ---- backward half-tick ----
@@ -391,10 +530,19 @@ class PipelineModule:
             b_valid = jnp.logical_and(m_b >= 0, m_b < M)
             m_bc = jnp.clip(m_b, 0, M - 1)
             rsel = (jnp.arange(BUF + 1) == m_bc % BUF)[:, None, None, None]
-            h_saved = jnp.sum(jnp.where(rsel, bufs, 0), axis=0,
-                              dtype=bufs.dtype)
-            (_, lossm), (gl, gr, gh) = bwd(params["layers"], rest, h_saved,
-                                           m_bc, cot_state)
+            if save:
+                xs_saved = jnp.sum(jnp.where(rsel[:, None], acts, 0), axis=0,
+                                   dtype=acts.dtype)
+                out_saved = jnp.sum(jnp.where(rsel, outs, 0), axis=0,
+                                    dtype=outs.dtype)
+                (_, lossm), (gl, gr, gh) = bwd_saved(
+                    params["layers"], rest, xs_saved, out_saved, m_bc,
+                    cot_state)
+            else:
+                h_saved = jnp.sum(jnp.where(rsel, bufs, 0), axis=0,
+                                  dtype=bufs.dtype)
+                (_, lossm), (gl, gr, gh) = bwd(params["layers"], rest,
+                                               h_saved, m_bc, cot_state)
             bm = b_valid.astype(jnp.float32)
             g_layers = jax.tree_util.tree_map(
                 lambda a, g: a + bm * g.astype(jnp.float32), g_layers, gl)
@@ -440,4 +588,6 @@ def maybe_wrap_pipeline(model, config, topology):
             schedule = "gpipe"
     elif schedule == "auto":
         schedule = "1f1b"
-    return PipelineModule(model, pp, micro_batches=micro, schedule=schedule)
+    return PipelineModule(model, pp, micro_batches=micro, schedule=schedule,
+                          save_activations=config.pipeline
+                          .pipe_save_activations)
