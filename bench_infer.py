@@ -180,8 +180,36 @@ def run_inference_bench(cfg=None, occupancies: Sequence[int] = (8, 32, 128),
         }
         eng.flush(uids)
 
+    # int8/int4 WEIGHTS (+ int8 KV): decode on a bandwidth-limited chip is
+    # weight-bound, so the fused dequant-matmul kernel's 2x/4x weight-read
+    # cut is the biggest remaining lever (reference cutlass mixed_gemm /
+    # init_inference(dtype=int8))
+    wq_bytes = {}
+    for wd in ("int8", "int4"):
+        del eng
+        eng = InferenceEngineV2(model, params=params, max_sequences=max_seqs,
+                                max_seq_len=ctx, block_size=128,
+                                kv_dtype="int8", weight_dtype=wd)
+        wq_bytes[wd] = int(sum(
+            np.dtype(p.dtype).itemsize * p.size
+            for p in jax.tree_util.tree_leaves(eng.params)))
+        for occ in [o for o in occupancies if o >= 32] or [max(occupancies)]:
+            uids = list(range(occ))
+            build_context(uids)
+            toks = [0] * occ
+            eng.decode_batch(uids, toks, steps=decode_steps)  # warmup
+            t0 = time.perf_counter()
+            eng.decode_batch(uids, toks, steps=decode_steps)
+            dt = time.perf_counter() - t0
+            decode[f"{occ}_w{wd}_int8kv"] = {
+                "tokens_per_sec": round(occ * decode_steps / dt, 1),
+                "ms_per_token": round(dt / decode_steps * 1e3, 3),
+            }
+            eng.flush(uids)
+
     return {
         "decode": decode,
+        "quant_weight_bytes": wq_bytes,
         "prefill_tokens_per_sec": round(prefill_dev_tps, 1),
         "prefill_e2e_tokens_per_sec": round(prefill_e2e_tps, 1),
         "prompt_len": prompt,

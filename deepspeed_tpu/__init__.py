@@ -71,6 +71,9 @@ def init_inference(model=None, config=None, checkpoint=None, dtype=None,
     ``engine.save_checkpoint``; pass the ``model``) or a HuggingFace
     checkpoint directory (``config.json`` + safetensors; ``model`` may be
     omitted — the family importer builds it).
+
+    ``dtype="int8"``/``"int4"`` serves quantized weights through the fused
+    dequant-matmul kernel (reference ``init_inference(dtype=torch.int8)``).
     """
     import os as _os
 
@@ -78,10 +81,14 @@ def init_inference(model=None, config=None, checkpoint=None, dtype=None,
 
     if checkpoint is not None and "params" not in kwargs:
         if _os.path.exists(_os.path.join(checkpoint, "config.json")):
+            from deepspeed_tpu.inference.quant import parse_weight_dtype
             from deepspeed_tpu.models.hf import load_hf_checkpoint
 
-            hf_model, params = load_hf_checkpoint(
-                checkpoint, dtype=dtype or "float32")
+            # int dtypes quantize in the engine; the checkpoint loads float
+            load_dtype = (dtype if parse_weight_dtype(dtype) == "bf16"
+                          else None) or "float32"
+            hf_model, params = load_hf_checkpoint(checkpoint,
+                                                  dtype=load_dtype)
             model = model if model is not None else hf_model
             kwargs["params"] = params
         else:
@@ -92,4 +99,4 @@ def init_inference(model=None, config=None, checkpoint=None, dtype=None,
             from deepspeed_tpu.runtime.checkpoint import load_params_only
 
             kwargs["params"] = load_params_only(checkpoint)
-    return InferenceEngine(model=model, config=config, **kwargs)
+    return InferenceEngine(model=model, config=config, dtype=dtype, **kwargs)

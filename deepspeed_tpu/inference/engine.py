@@ -135,6 +135,17 @@ class InferenceEngine:
                                  out_shardings=self.param_sharding)(jax.random.key(0))
             else:
                 params = jax.device_put(params, self.param_sharding)
+        from deepspeed_tpu.inference.quant import (parse_weight_dtype,
+                                                   quantize_serving_params)
+
+        wd = parse_weight_dtype(dtype)
+        if wd != "bf16":
+            # reference init_inference(dtype=torch.int8): serve packed
+            # weights through the fused dequant-matmul kernel (the model's
+            # linear() seam picks the QuantizedWeight leaves up on every
+            # path, including generate's cached decode)
+            params = quantize_serving_params(
+                params, self.cfg, 4 if wd == "int4" else 8, self.mesh)
         self.params = params
 
         self._step = jax.jit(model.forward_with_cache)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.quant import QUANT_LEAVES
 from deepspeed_tpu.inference.ragged import SequenceManager
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.utils.logging import log_dist
@@ -37,7 +38,8 @@ class InferenceEngineV2:
                  max_seq_len: Optional[int] = None, block_size: int = 128,
                  num_blocks: Optional[int] = None, paged: bool = True,
                  packed: bool = True, topology=None,
-                 mesh: Optional[dict] = None, kv_dtype: str = "bf16"):
+                 mesh: Optional[dict] = None, kv_dtype: str = "bf16",
+                 weight_dtype: str = "bf16"):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from deepspeed_tpu.parallel import build_mesh
@@ -79,6 +81,19 @@ class InferenceEngineV2:
             else:
                 params = jax.jit(_serve_cast,
                                  out_shardings=self.param_sharding)(params)
+        if weight_dtype not in ("bf16", "int8", "int4"):
+            raise ValueError(f"weight_dtype must be bf16|int8|int4, got "
+                             f"{weight_dtype!r}")
+        self.weight_dtype = weight_dtype
+        if weight_dtype != "bf16":
+            # decode is weight-bandwidth-bound: swap the big matmul leaves
+            # (layer stack + an int copy of the LM head table) for packed
+            # QuantizedWeight nodes — every forward path picks them up
+            # through the model's linear() seam, cutting decode HBM reads
+            # 2x (int8) / 4x (int4). The embedding GATHER keeps the bf16
+            # table (it reads B rows/step, not the full [V, D]).
+            params = self._quantize_weights(
+                params, bits=4 if weight_dtype == "int4" else 8)
         self.params = params
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
@@ -133,6 +148,13 @@ class InferenceEngineV2:
             self.cache = model.init_kv_cache(max_sequences, self.max_seq_len)
             self._step = jax.jit(model.forward_with_cache)
         self.packed = packed and paged
+
+    _QUANT_LEAVES = QUANT_LEAVES
+
+    def _quantize_weights(self, params, bits: int):
+        from deepspeed_tpu.inference.quant import quantize_serving_params
+
+        return quantize_serving_params(params, self.cfg, bits, self.mesh)
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
     def query(self, uid: int, n_tokens: int) -> bool:

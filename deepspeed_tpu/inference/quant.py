@@ -1,0 +1,85 @@
+"""Serving-time weight quantization shared by both inference engines.
+
+Parity: ``deepspeed.init_inference(dtype=torch.int8)`` +
+``inference/v2/kernels/cutlass_ops/mixed_gemm`` — the reference serves int8
+weights through a mixed-input GEMM. Here the big matmul leaves of the layer
+stack (and an int copy of the LM head table) are swapped for packed
+:class:`~deepspeed_tpu.models.transformer.QuantizedWeight` nodes; every
+forward path picks them up through the model's ``linear()`` seam and runs
+the fused dequant-matmul Pallas kernel (``ops/quant_matmul.py``), cutting
+decode weight-bandwidth 2x (int8) / 4x (int4). The embedding GATHER keeps
+the bf16 table — it reads B rows per step, not the full [V, D].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_serving_params(params, cfg, bits: int, mesh):
+    """Return ``params`` with quantizable leaves replaced (non-destructive:
+    builds new dicts along the touched paths)."""
+    from deepspeed_tpu.models.transformer import QuantizedWeight
+    from deepspeed_tpu.ops.quant_matmul import quantize_matmul_weight
+
+    cdt = jnp.dtype(cfg.dtype)
+
+    def q2(w2d):
+        packed, scales = quantize_matmul_weight(w2d.astype(jnp.float32),
+                                                bits=bits)
+        # compute-dtype scales survive the engines' cast tree_maps; the
+        # kernel upcasts them to fp32 internally
+        return packed, scales.astype(cdt)
+
+    def q_stacked(w):  # [L, Din, F] → QuantizedWeight of stacked leaves
+        if w.ndim != 3 or w.shape[1] % 128 or w.shape[2] % 128:
+            return w  # MoE expert stacks / odd geometries stay dense
+        ps = [q2(w[i]) for i in range(w.shape[0])]
+        return QuantizedWeight(jnp.stack([p for p, _ in ps]),
+                               jnp.stack([s for _, s in ps]),
+                               bits, w.shape[1])
+
+    with jax.sharding.set_mesh(mesh):
+        layers = dict(params["layers"])
+        for grp in ("attn", "mlp"):
+            sub = dict(layers[grp])
+            for name in QUANT_LEAVES:
+                if name in sub:
+                    sub[name] = jax.jit(q_stacked)(sub[name])
+            layers[grp] = sub
+        params = {**params, "layers": layers}
+        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        D, V = head.shape
+        if D % 128 == 0 and V % 128 == 0:
+            packed, scales = jax.jit(lambda h: q2(h.astype(jnp.float32)))(
+                head)
+            params["lm_head_q"] = QuantizedWeight(packed, scales, bits, D)
+            if not cfg.tie_embeddings:
+                # _head() prefers lm_head_q; keeping the dense head resident
+                # would hold the HBM the quantization exists to reclaim
+                # (tied models keep the table — the embedding gather reads it)
+                params.pop("lm_head", None)
+    return params
+
+
+def parse_weight_dtype(dtype) -> str:
+    """Map an ``init_inference``-style dtype (string, numpy/jax dtype or
+    scalar type) to a weight_dtype string."""
+    if dtype is None:
+        return "bf16"
+    if isinstance(dtype, str):
+        s = dtype
+    else:
+        try:
+            import numpy as np
+
+            s = np.dtype(dtype).name      # jnp.int8 / np.int8 / "int8"
+        except TypeError:
+            s = str(dtype).replace("torch.", "")
+    if s in ("int8", "int4"):
+        return s
+    return "bf16"

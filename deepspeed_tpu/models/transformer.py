@@ -71,7 +71,10 @@ class TransformerConfig:
     param_dtype: str = "float32"   # storage dtype (master weights)
     remat_policy: str = "none"     # runtime.activation_checkpointing.POLICIES
     scan_layers: bool = True
-    attention_impl: str = "auto"   # auto|xla|flash|ring
+    attention_impl: str = "auto"   # auto|xla|flash|ring|fpdt
+    # FPDT q/kv chunk length for attention_impl="fpdt" (None → the
+    # sequence.fpdt default); both fpdt tiers read it
+    fpdt_chunk: Optional[int] = None
     # compression_training activation_quantization: fake-quantize MLP block
     # inputs with straight-through gradients when set (e.g. 8)
     act_quant_bits: Optional[int] = None
@@ -272,12 +275,58 @@ def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = 
     return out if tail is None else jnp.concatenate([out, tail], axis=-1)
 
 
+class QuantizedWeight:
+    """Packed int4/int8 matmul weight usable anywhere a dense [Din, F]
+    array sits in the param tree (``ops/quant_matmul`` layout — reference
+    ``inference/v2/kernels/cutlass_ops/mixed_gemm``): :func:`linear`
+    dispatches it to the fused dequant-matmul Pallas kernel, so the serving
+    engines cut decode weight-bandwidth 2x/4x by swapping leaves without
+    touching any forward code. A pytree node whose children (packed,
+    scales) stack/slice/shard exactly like the dense leaf they replace."""
+
+    __slots__ = ("packed", "scales", "bits", "din")
+
+    def __init__(self, packed: jax.Array, scales: jax.Array, bits: int,
+                 din: int):
+        self.packed, self.scales = packed, scales
+        self.bits, self.din = bits, din
+
+    def tree_flatten(self):
+        return (self.packed, self.scales), (self.bits, self.din)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def nbytes(self) -> int:
+        return self.packed.nbytes + self.scales.nbytes
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight, QuantizedWeight.tree_flatten,
+    QuantizedWeight.tree_unflatten)
+
+
+def linear(x: jax.Array, w) -> jax.Array:
+    """``x [..., Din] @ w`` where ``w`` is a dense array or a
+    :class:`QuantizedWeight` (fused dequant-matmul kernel)."""
+    if isinstance(w, QuantizedWeight):
+        from deepspeed_tpu.ops.quant_matmul import quantized_matmul
+
+        lead = x.shape[:-1]
+        out = quantized_matmul(x.reshape(-1, w.din), w.packed, w.scales,
+                               bits=w.bits)
+        return out.reshape(*lead, out.shape[-1])
+    return x @ w
+
+
 def qkv_proj(x: jax.Array, w: Params, cfg: TransformerConfig):
     """Shared q/k/v projection (+ optional qwen-style biases) for every
     forward path (train, dense decode, paged decode)."""
     B, T = x.shape[0], x.shape[1]
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-    q, k, v = x @ w["wq"], x @ w["wk"], x @ w["wv"]
+    q, k, v = linear(x, w["wq"]), linear(x, w["wk"]), linear(x, w["wv"])
     if "bq" in w:
         q, k, v = q + w["bq"], k + w["bk"], v + w["bv"]
     return (q.reshape(B, T, H, hd), k.reshape(B, T, K, hd),
@@ -287,7 +336,7 @@ def qkv_proj(x: jax.Array, w: Params, cfg: TransformerConfig):
 def attn_out_proj(attn: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
     """[B, T, H, hd] attention output → [B, T, D] (+ optional bias)."""
     B, T = attn.shape[0], attn.shape[1]
-    o = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ w["wo"]
+    o = linear(attn.reshape(B, T, cfg.num_heads * cfg.head_dim), w["wo"])
     return o + w["bo"] if "bo" in w else o
 
 
@@ -308,6 +357,17 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                     positions: Optional[jax.Array] = None) -> jax.Array:
     B, T, D = x.shape
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    if (cfg.attention_impl == "fpdt" and positions is None
+            and cfg.sliding_window is None):
+        # fused per-chunk-projection tier: q/k/v never materialize full-T
+        # (sequence/fpdt.py module docstring). Falls through to the seam
+        # path (full-T projection + chunked fpdt_attention) only when T is
+        # too short to chunk.
+        from deepspeed_tpu.sequence.fpdt import fpdt_block_attention
+
+        o = fpdt_block_attention(x, w, cfg, freqs)
+        if o is not None:
+            return constrain(o, P(("dp", "fsdp"), "sp", None))
     q, k, v = qkv_proj(x, w, cfg)
     q = constrain(q, P(("dp", "fsdp"), "sp", "tp", None))
     k = constrain(k, P(("dp", "fsdp"), "sp", "tp", None))
@@ -381,17 +441,17 @@ def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
 
         x = ste_quantize(x, bits=cfg.act_quant_bits)
     if cfg.activation == "swiglu":
-        h = jax.nn.silu(x @ w["w_gate"]) * (x @ w["w_up"])
+        h = jax.nn.silu(linear(x, w["w_gate"])) * linear(x, w["w_up"])
     else:
         # gelu = tanh-approx (HF gelu_new/gelu_pytorch_tanh, gpt2 family);
         # gelu_exact = erf gelu (HF "gelu": falcon/gpt-neox); relu = opt
         act = {"gelu": partial(jax.nn.gelu, approximate=True),
                "gelu_exact": partial(jax.nn.gelu, approximate=False),
                "relu": jax.nn.relu}[cfg.activation]
-        up = x @ w["w_up"]
+        up = linear(x, w["w_up"])
         h = act(up + w["b_up"] if "b_up" in w else up)
     h = constrain(h, P(("dp", "fsdp"), "sp", "tp"))
-    out = h @ w["w_down"]
+    out = linear(h, w["w_down"])
     return out + w["b_down"] if "b_down" in w else out
 
 
@@ -554,15 +614,26 @@ class TransformerLM:
 
     # ---- forward ----------------------------------------------------------
     def _head(self, params: Params):
-        """[D, V] output projection (tied or separate)."""
+        """[D, V] output projection (tied or separate). Serving engines may
+        install a quantized copy under ``lm_head_q`` (the head matmul reads
+        the whole [D, V] table every decode step; the embedding GATHER keeps
+        the bf16 table)."""
+        if "lm_head_q" in params:
+            return params["lm_head_q"]
         return (params["embed"]["tokens"].T if self.cfg.tie_embeddings
                 else params["lm_head"])
+
+    def _head_proj(self, params: Params, x: jax.Array) -> jax.Array:
+        """``x [..., D] @ head`` for every logits site (dense or quantized)."""
+        head = self._head(params)
+        if isinstance(head, QuantizedWeight):
+            return linear(x, head)
+        return x @ head.astype(jnp.dtype(self.cfg.dtype))
 
     def _project(self, params: Params, hidden: jax.Array) -> jax.Array:
         """hidden [B, T, D] → logits [B, T, V] with the canonical sharding."""
         with jax.named_scope("lm_head"):
-            logits = hidden @ self._head(params).astype(
-                jnp.dtype(self.cfg.dtype))
+            logits = self._head_proj(params, hidden)
         return constrain(logits, P(("dp", "fsdp"), "sp", "tp"))
 
     def logits(self, params: Params, input_ids: jax.Array,
@@ -840,7 +911,7 @@ class TransformerLM:
         nk = nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts)
         nv = nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts)
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-        logits = x @ self._head(params).astype(dt)
+        logits = self._head_proj(params, x)
         new_cache = {"k": nk, "v": nv, "pos": pos + t}
         return logits, new_cache
 
@@ -943,7 +1014,7 @@ class TransformerLM:
         nk = nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts)
         nv = nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts)
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-        logits = x @ self._head(params).astype(dt)
+        logits = self._head_proj(params, x)
         return logits, {"k": nk, "v": nv}
 
     MAX_ATOM = 256   # widest prefill atom (VMEM-bounded); engines chunk longer prompts
@@ -1074,7 +1145,7 @@ class TransformerLM:
                                   tok_pos, valid)
             new_cache = {"k": nk, "v": nv}
         x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
-        logits = x[gather_idx] @ self._head(params).astype(dt)   # [G, V]
+        logits = self._head_proj(params, x[gather_idx])         # [G, V]
         return logits, new_cache
 
     PREFILL_MAX = 4096   # widest whole-prompt prefill (longer prompts chunk)
@@ -1145,7 +1216,7 @@ class TransformerLM:
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
         last = jnp.clip(lengths - 1, 0, T - 1)
         xg = x[jnp.arange(B), last]                              # [B, D]
-        logits = xg @ self._head(params).astype(dt)
+        logits = self._head_proj(params, xg)
         return logits, {"k": kr, "v": vr}
 
     def forward_decode_tail(self, params: Params, toks: jax.Array,
@@ -1258,7 +1329,7 @@ class TransformerLM:
             (x, tk, tv), _ = jax.lax.scan(make_body(cseg), (x, tk, tv),
                                           seg_xs)
         x = _norm(x[:, 0], params["final_norm"], cfg.norm, cfg.norm_eps)
-        logits = x @ self._head(params).astype(dt)               # [B, V]
+        logits = self._head_proj(params, x)                      # [B, V]
         return logits, {"k": tk, "v": tv}
 
     # ---- sharding ---------------------------------------------------------

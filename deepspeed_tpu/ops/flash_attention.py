@@ -243,13 +243,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, window, block_q,
-                block_k, interpret):
+                block_k, interpret, dlse=None):
     B, H, T, d = q.shape
     S, K = k.shape[2], k.shape[1]
     rep = H // K
     nq, nk = T // block_q, S // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [B,H,T,1]
+    if dlse is not None:
+        # lse cotangent (the lse-returning variant): d lse/d s = p, so the
+        # extra term p*dlse folds into the kernels' ds = p*(dp - delta) as
+        # delta' = delta - dlse — no kernel change
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -339,6 +344,58 @@ def _flash_bwd(causal, window, block_q, block_k, interpret, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, window, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, causal, window, block_q, block_k,
+                          interpret)
+    return out, res[-1]
+
+
+def _flash_lse_fwd(q, k, v, causal, window, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, causal, window, block_q, block_k,
+                          interpret)
+    return (out, res[-1]), res
+
+
+def _flash_lse_bwd(causal, window, block_q, block_k, interpret, res, ct):
+    do, dlse = ct
+    q, k, v, out, lse = res
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    dq, dk, dv = _bwd_pallas(q, k, v, out, lse, do, scale=scale,
+                             causal=causal, window=window, block_q=block_q,
+                             block_k=block_k, interpret=interpret, dlse=dlse)
+    return dq, dk, dv
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        interpret: Optional[bool] = None):
+    """Flash attention that ALSO returns the log-sum-exp rows, fully
+    differentiable in both outputs: ``(out [B,T,H,d], lse [B,H,T,1])``.
+
+    The lse output is what makes chunked/merged attention composable
+    (sequence/fpdt.py pair merge; flash-decode-style split reductions):
+    two chunk results merge exactly via
+    ``m=max(l1,l2); o=(e^{l1-m} o1 + e^{l2-m} o2)/(e^{l1-m}+e^{l2-m})``.
+    GQA is native — k/v keep their K heads, the kernel maps query head h
+    to kv head h//(H/K)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    T, S = q.shape[1], k.shape[1]
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(S, block_k)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = _flash_lse(qt, kt, vt, causal, None, bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,

@@ -56,36 +56,35 @@ def quantize_matmul_weight(w: jax.Array, bits: int = 4, group: int = 128
     return packed.reshape(D // 2, F), scale
 
 
-def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, bits: int, group: int,
-                n_d: int):
-    d = pl.program_id(1)
-
-    @pl.when(d == 0)
-    def _init():
-        acc[:] = jnp.zeros_like(acc)
-
-    q = q_ref[:]                            # int8 [group(/2), bf]
-    s = s_ref[0]                            # fp32 [1, bf]
-    if bits == 4:
-        # nibble unpack in float arithmetic: Mosaic does not legalize int8
-        # vector shifts (arith.shli), and -128..127 is exact in fp32
-        qf = q.astype(jnp.float32)
-        u = qf + 256.0 * (qf < 0)           # unsigned byte value
-        hi_n = jnp.floor(u / 16.0)
-        lo_n = u - 16.0 * hi_n
-        lo = lo_n - 16.0 * (lo_n >= 8)      # sign-extend nibbles
-        hi = hi_n - 16.0 * (hi_n >= 8)
-        wt = jnp.concatenate([lo, hi], axis=0)   # [group, bf]
-    else:
-        wt = q.astype(jnp.float32)
-    wt = (wt * s).astype(jnp.bfloat16)
-    acc[:] += jax.lax.dot_general(
-        x_ref[:], wt, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(d == n_d - 1)
-    def _done():
-        o_ref[:] = acc[:].astype(o_ref.dtype)
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bits: int, group: int,
+                n_g: int):
+    # whole contraction dim per f-block: ONE [D/2(, D), bf]-sized DMA and ONE
+    # MXU dot per grid step. A (f, group)-blocked grid issued ~32 KB weight
+    # DMAs, which stream far below the rate big XLA dots reach — the packed
+    # weight read must be the step's single large sequential stream for the
+    # 2x/4x bandwidth cut to show up as wall-clock.
+    rows = group // 2 if bits == 4 else group
+    tiles = []
+    for g in range(n_g):                    # static unroll over groups
+        q = q_ref[g * rows:(g + 1) * rows, :]    # int8 [rows, bf]
+        s = s_ref[g]                             # fp32 [1, bf]
+        if bits == 4:
+            # nibble unpack in float arithmetic: Mosaic does not legalize
+            # int8 vector shifts (arith.shli), and -128..127 is exact in fp32
+            qf = q.astype(jnp.float32)
+            u = qf + 256.0 * (qf < 0)            # unsigned byte value
+            hi_n = jnp.floor(u / 16.0)
+            lo_n = u - 16.0 * hi_n
+            lo = lo_n - 16.0 * (lo_n >= 8)       # sign-extend nibbles
+            hi = hi_n - 16.0 * (hi_n >= 8)
+            wt = jnp.concatenate([lo, hi], axis=0)   # [group, bf]
+        else:
+            wt = q.astype(jnp.float32)
+        tiles.append((wt * s).astype(jnp.bfloat16))
+    w_full = jnp.concatenate(tiles, axis=0)      # bf16 [D, bf]
+    o_ref[:] = jax.lax.dot_general(
+        x_ref[:], w_full, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
 def quantized_matmul(x: jax.Array, packed: jax.Array, scales: jax.Array,
@@ -93,34 +92,40 @@ def quantized_matmul(x: jax.Array, packed: jax.Array, scales: jax.Array,
                      interpret: bool = None) -> jax.Array:
     """``x`` [B, D] @ dequant(packed, scales) → [B, F], weights expanded only
     in VMEM. Falls back to the XLA dequant-then-matmul outside the kernel's
-    sweet spot (tiny shapes, non-TPU geometries)."""
+    sweet spot (tiny shapes, large activation batches, non-TPU geometries)."""
     if interpret is None:
         interpret = not _on_tpu()
     B, D = x.shape
     G, F = scales.shape
     group = D // G
     assert packed.shape[0] == (D // 2 if bits == 4 else D)
-    if D % 128 or F % 128 or group % 128 or B > 1024:
+    if D % 128 or F % 128 or group % 128 or B > 256:
+        # large-B (prefill) shapes are compute-bound — the XLA fallback
+        # fuses the dequant into the dot's operand read
         return x @ dequantize_matmul_weight(packed, scales, bits, D)
     bf = min(block_f, F)
     while F % bf:
         bf //= 2
-    if bf % 128:
+    # VMEM budget: the whole-x (B, D) block + unpacked bf16 [D, bf] tile +
+    # double-buffered packed input must fit; shrink the f-block for wide D
+    # and fall back entirely when x alone blows the budget
+    x_bytes = B * D * x.dtype.itemsize
+    while bf > 128 and D * bf * 3 + x_bytes > 10 * 1024 * 1024:
+        bf //= 2
+    if bf % 128 or D * bf * 3 + x_bytes > 12 * 1024 * 1024:
         return x @ dequantize_matmul_weight(packed, scales, bits, D)
     rows = group // 2 if bits == 4 else group
-    kernel = functools.partial(_qmm_kernel, bits=bits, group=group, n_d=G)
-    grid = (F // bf, G)
+    kernel = functools.partial(_qmm_kernel, bits=bits, group=group, n_g=G)
     out = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(F // bf,),
         in_specs=[
-            pl.BlockSpec((B, group), lambda f, d: (0, d)),
-            pl.BlockSpec((rows, bf), lambda f, d: (d, f)),
-            pl.BlockSpec((1, 1, bf), lambda f, d: (d, 0, f)),
+            pl.BlockSpec((B, D), lambda f: (0, 0)),
+            pl.BlockSpec((G * rows, bf), lambda f: (0, f)),
+            pl.BlockSpec((G, 1, bf), lambda f: (0, 0, f)),
         ],
-        out_specs=pl.BlockSpec((B, bf), lambda f, d: (0, f)),
+        out_specs=pl.BlockSpec((B, bf), lambda f: (0, f)),
         out_shape=jax.ShapeDtypeStruct((B, F), x.dtype),
-        scratch_shapes=[pltpu.VMEM((B, bf), jnp.float32)],
         interpret=interpret,
     )(x, packed, scales.astype(jnp.float32).reshape(G, 1, F))
     return out

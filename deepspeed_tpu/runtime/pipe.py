@@ -211,11 +211,23 @@ class PipelineModule:
                 "batch size)")
         mb = B // M
 
-        # embedding (computed on every stage; only stage 0's result is consumed)
-        x = params["embed"]["tokens"].astype(dt)[ids]
-        if cfg.learned_pos:
-            x = x + params["embed"]["pos"][:T].astype(dt)
-        x_mb = x.reshape(M, mb, T, -1)
+        # per-tick embedding (computed on every stage; only stage 0's result
+        # is consumed — the gather is bandwidth-trivial next to a stage's
+        # layer stack). Embedding per tick keeps one [mb, T, D] inject alive
+        # instead of an upfront [M, mb, T, D] buffer of the whole batch.
+        # The table is pinned replicated ONCE first: per-tick gathers over
+        # an auto-fsdp-sharded operand inside the pp-manual region trip the
+        # spmd_partitioner_util.cc:495 group-math check (ZeRO-3 gathers for
+        # compute anyway — this is that gather, done explicitly).
+        tbl = lax.with_sharding_constraint(
+            params["embed"]["tokens"].astype(dt), P(None, None))
+        ids_mb = ids.reshape(M, mb, T)
+
+        def embed_mb(t):
+            x = tbl[ids_mb[min(t, M - 1)]]
+            if cfg.learned_pos:
+                x = x + params["embed"]["pos"][:T].astype(dt)
+            return x
 
         def stage_fn(layers_local, h):
             def body(carry, layer_w):
@@ -228,7 +240,8 @@ class PipelineModule:
         if self.remat:
             stage_fn = jax.checkpoint(stage_fn)
 
-        state = lax.pvary(jnp.zeros((mb, T, x.shape[-1]), x.dtype), "pp")
+        D = params["embed"]["tokens"].shape[1]
+        state = lax.pvary(jnp.zeros((mb, T, D), dt), "pp")
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         # GPipe schedule, unrolled over the (static) M + n - 1 ticks. Unrolling
@@ -239,8 +252,7 @@ class PipelineModule:
         # (schedule.py:189 yields a static 1F1B instruction sequence)).
         collected = []
         for t in range(M + n - 1):
-            inject = x_mb[min(t, M - 1)]
-            cur = jnp.where(idx == 0, inject, state)
+            cur = jnp.where(idx == 0, embed_mb(t), state)
             out = stage_fn(params["layers"], cur)
             if t >= n - 1:
                 collected.append(out)

@@ -15,10 +15,31 @@ FLOPs scale with the visible context. The backward re-fetches chunks from
 host (the transfer replays under remat) instead of keeping device copies
 alive, so the attention working set is O(chunk^2) regardless of T.
 
-This lowers the attention+KV residency from O(T) device bytes to O(chunk);
-the qkv projections still materialize full K/V transiently at the attention
-boundary (the attention-impl seam receives computed k/v — documented gap vs
-the reference's fused per-chunk projection).
+Two tiers live here:
+
+* :func:`fpdt_attention` — the attention-impl seam (receives computed q/k/v,
+  hosts the KV chunks). Max context is bounded by the O(T) K/V the caller's
+  projections materialize.
+* :func:`fpdt_block_attention` — the fused block path (reference
+  ``fpdt_layer.py:545`` chunks the projections too): takes the normed
+  residual stream and computes q per chunk and K/V per (q-chunk, kv-chunk)
+  pair, so **no full-T q/k/v is ever resident** — forward or backward.
+
+The fused path makes a deliberately TPU-native tradeoff: where the
+reference streams pre-computed KV chunks back from pinned host memory, it
+RECOMPUTES each [chunk]-sized K/V from the (device-resident) residual
+stream at the point of use. Recompute costs ``2·c·D·2K·hd`` MXU flops per
+pair against ``4·c²·H·hd`` attention flops — a ``K·hd/c`` overhead (3–12%
+at chunk 4–16k for GQA shapes) — while host streaming moves ``4·c·K·hd``
+bytes/pair over PCIe-class bandwidth: at D≈4k the stream takes as long as
+the recompute, fights the optimizer-offload tiers for the same host link,
+and (measured on this image) XLA:TPU aborts programs that mix host-memory
+transfers with embedding gathers. Recompute needs neither the transfer nor
+a full-T host stash: the only O(T) arrays anywhere are the residual-stream
+activations themselves. An in-jit host stash was also measured to
+materialize its full-T zeros INIT in device temp (the host-offloading
+pass cannot sink a broadcast to host), which would have kept the O(T)
+device footprint the fused path exists to remove.
 """
 
 from __future__ import annotations
@@ -30,9 +51,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deepspeed_tpu.models.transformer import repeat_kv
+from deepspeed_tpu.models.transformer import apply_rope, linear, repeat_kv
 
 DEFAULT_CHUNK = 4096
+# fused-tier default chunk: each (q-chunk, kv-chunk) pair runs the flash
+# kernel (VMEM-tiled — no [c, c] tile in HBM), so the chunk only bounds the
+# per-pair q/kv working set; 4096 puts the projection-recompute overhead
+# (K*hd/c) at ~12% of pair attention flops for GQA shapes
+BLOCK_CHUNK = 4096
 
 
 def _shardings():
@@ -166,3 +192,104 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     _, outs = lax.scan(outer, None, jnp.arange(nc))
     return outs.transpose(1, 0, 2, 3, 4).reshape(B, T, H, d)
+
+
+def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
+                         *, chunk: Optional[int] = None) -> Optional[jax.Array]:
+    """Fused per-chunk-projection FPDT attention block (module docstring).
+
+    ``x`` [B, T, D] is the normed block input; ``w`` the attention weights
+    (``wq/wk/wv/wo`` + optional qwen biases). Returns the projected
+    attention output [B, T, D], or ``None`` when T is too short to chunk
+    (caller takes the dense path). Working set per step: one q chunk
+    [B, c, H, hd] + one recomputed KV chunk pair [B, c, K, hd]×2; the
+    per-pair ``jax.checkpoint`` makes the backward replay the projections
+    instead of saving them, so the cotangents of K/V flow chunk-wise into
+    (x, w) and never materialize full-T either.
+    """
+    B, T, D = x.shape
+    hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    c = min(chunk or getattr(cfg, "fpdt_chunk", None) or BLOCK_CHUNK, T)
+    if T % c:
+        c = max(d_ for d_ in range(1, c + 1) if T % d_ == 0)
+    nc = T // c
+    if nc == 1 or c < 64:
+        return None
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty \
+            and mesh.shape.get("sp", 1) > 1:
+        # chunk slicing over an sp-sharded T would turn every pair into a
+        # cross-shard gather; under SP the seam path (full-T projection +
+        # ulysses/fpdt attention impl) is the right composition
+        return None
+    has_b = "bq" in w
+
+    def _pos(i):
+        return jnp.broadcast_to(i * c + jnp.arange(c)[None], (B, c))
+
+    def kv_chunk(j):
+        """[B, c, K, hd] roped k / v — recomputed at every (i, j) use."""
+        xj = lax.dynamic_slice_in_dim(x, j * c, c, axis=1)
+        kj, vj = linear(xj, w["wk"]), linear(xj, w["wv"])
+        if has_b:
+            kj, vj = kj + w["bk"], vj + w["bv"]
+        kj = kj.reshape(B, c, K, hd)
+        vj = vj.reshape(B, c, K, hd)
+        if cfg.use_rope:
+            kj = apply_rope(kj, freqs, _pos(j))
+        return kj, vj
+
+    def q_chunk(i):
+        from deepspeed_tpu.ops.flash_attention import flash_attention_lse
+
+        xi = lax.dynamic_slice_in_dim(x, i * c, c, axis=1)
+        qi = linear(xi, w["wq"])
+        if has_b:
+            qi = qi + w["bq"]
+        qi = qi.reshape(B, c, H, hd)
+        if cfg.use_rope:
+            qi = apply_rope(qi, freqs, _pos(i))
+
+        def merge(carry, pair):
+            # normalized-output merge of two flash results: exact because
+            # lse carries each side's softmax mass
+            o_run, l_run = carry
+            o_j, l_j = pair
+            m = jnp.maximum(l_run, l_j)
+            w1 = jnp.exp(l_run - m)             # [B, H, c, 1]
+            w2 = jnp.exp(l_j - m)
+            tot = w1 + w2
+            w1t = (w1 / tot).transpose(0, 2, 1, 3)
+            w2t = (w2 / tot).transpose(0, 2, 1, 3)
+            o = o_run * w1t + o_j.astype(jnp.float32) * w2t
+            return o, m + jnp.log(tot)
+
+        def kv_step(j, carry):
+            # each pair runs the training-grade flash kernel (VMEM-tiled,
+            # GQA-native — no repeated KV, no [c, c] score tile in HBM);
+            # the diagonal pair is the only one needing the causal mask
+            def pair(carry, causal):
+                return merge(carry, flash_attention_lse(
+                    qi, *kv_chunk(j), causal=causal))
+
+            return lax.cond(
+                j < i, lambda cr: pair(cr, False),
+                lambda cr: lax.cond(j == i, lambda c_: pair(c_, True),
+                                    lambda c_: c_, cr), carry)
+
+        o0 = jnp.zeros((B, c, H, hd), jnp.float32)
+        l0 = jnp.full((B, H, c, 1), -1e30, jnp.float32)
+        # per-pair remat (see fpdt_attention.kv_step): without it autodiff
+        # saves the per-pair recomputed KV and flash residuals
+        kv_step = jax.checkpoint(kv_step, static_argnums=())
+        o, _ = lax.fori_loop(0, nc, kv_step, (o0, l0))
+        o = linear(o.astype(x.dtype).reshape(B, c, H * hd), w["wo"])
+        return o + w["bo"] if "bo" in w else o
+
+    q_chunk = jax.checkpoint(q_chunk)
+
+    def outer(_, i):
+        return None, q_chunk(i)
+
+    _, outs = lax.scan(outer, None, jnp.arange(nc))
+    return outs.transpose(1, 0, 2, 3).reshape(B, T, D)

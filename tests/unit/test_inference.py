@@ -563,6 +563,113 @@ def test_joint_capacity_rejected_before_any_scheduling(tiny_lm):
     assert eng.state.sequences[1].seen_tokens == 64
 
 
+class TestWeightQuantServing:
+    """int8/int4 weight serving through the linear() seam (reference
+    ``init_inference(dtype=torch.int8)`` + the cutlass mixed-GEMM path):
+    the engine swaps matmul leaves for packed QuantizedWeight nodes and
+    every forward path (prefill, packed put, fused decode loop) consumes
+    them via the fused dequant-matmul kernel."""
+
+    @staticmethod
+    def _model():
+        from deepspeed_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=512, hidden_size=128,
+                                num_layers=2, num_heads=4, max_seq_len=256,
+                                arch="llama", tie_embeddings=False)
+        model = TransformerLM(cfg)
+        return model, model.init(jax.random.key(0))
+
+    @pytest.mark.parametrize("wd", ["int8", "int4"])
+    def test_quant_engine_serves(self, wd):
+        model, params = self._model()
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=256, block_size=32,
+                                weight_dtype=wd)
+        prompt = np.random.default_rng(0).integers(0, 512, 48)
+        first = eng.put([1], [prompt])[1]
+        assert np.isfinite(np.asarray(first, np.float32)).all()
+        toks = eng.decode_batch([1], [int(np.argmax(first))], steps=6)[1]
+        assert toks.shape == (6,)
+        # the packed tree must actually be smaller than the served bf16 tree
+        dense = InferenceEngineV2(model, params=params, max_sequences=4,
+                                  max_seq_len=256, block_size=32)
+
+        def nbytes(tree):
+            return sum(leaf.nbytes
+                       for leaf in jax.tree_util.tree_leaves(tree))
+
+        ratio = nbytes(eng.params) / nbytes(dense.params)
+        assert ratio < (0.75 if wd == "int8" else 0.55), ratio
+
+    def test_int8_matches_dequant_reference(self):
+        """Same effective (rounded) weights served dense vs packed must give
+        matching logits — isolates the kernel from the quantization loss."""
+        from deepspeed_tpu.ops.quant_matmul import (
+            dequantize_matmul_weight, quantize_matmul_weight)
+
+        model, params = self._model()
+        eng_q = InferenceEngineV2(model, params=params, max_sequences=4,
+                                  max_seq_len=256, block_size=32,
+                                  weight_dtype="int8")
+
+        import jax.numpy as jnp
+
+        def rq(w):  # round-trip a stacked [L, Din, F] leaf through int8,
+            # replicating the engine's compute-dtype scale storage
+            outs = []
+            for i in range(w.shape[0]):
+                p, s = quantize_matmul_weight(w[i].astype(np.float32), bits=8)
+                s = s.astype(jnp.bfloat16).astype(jnp.float32)
+                outs.append(dequantize_matmul_weight(p, s, 8, w.shape[1]))
+            return jnp.stack(outs).astype(w.dtype)
+
+        ref = jax.tree_util.tree_map(lambda p: p, params)
+        for grp in ("attn", "mlp"):
+            for name in InferenceEngineV2._QUANT_LEAVES:
+                if name in ref["layers"][grp]:
+                    ref["layers"][grp][name] = rq(ref["layers"][grp][name])
+        p, s = quantize_matmul_weight(
+            np.asarray(ref["lm_head"], np.float32), bits=8)
+        s = s.astype(jnp.bfloat16).astype(jnp.float32)
+        ref["lm_head"] = dequantize_matmul_weight(
+            p, s, 8, ref["lm_head"].shape[0]).astype(ref["lm_head"].dtype)
+        eng_d = InferenceEngineV2(model, params=ref, max_sequences=4,
+                                  max_seq_len=256, block_size=32)
+        prompt = np.random.default_rng(1).integers(0, 512, 40)
+        lq = np.asarray(eng_q.put([1], [prompt])[1], np.float32)
+        ld = np.asarray(eng_d.put([1], [prompt])[1], np.float32)
+        # identical effective weights; the residual spread is bf16
+        # accumulation order (kernel sums per 128-row group, XLA in one dot)
+        np.testing.assert_allclose(lq, ld, atol=0.2, rtol=0.2)
+        assert float(np.mean(np.abs(lq - ld))) < 2e-2
+
+    def test_v1_engine_int8_dtype(self):
+        """``init_inference(dtype='int8')`` parity surface: the v1 engine's
+        generate() serves packed weights through the same seam."""
+        import deepspeed_tpu as ds
+
+        model, params = self._model()
+        eng = ds.init_inference(model=model, dtype="int8", params=params)
+        ids = np.random.default_rng(3).integers(0, 512, (1, 16))
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (1, 20)
+        from deepspeed_tpu.models.transformer import QuantizedWeight
+
+        assert isinstance(eng.params["layers"]["attn"]["wq"], QuantizedWeight)
+        assert isinstance(eng.params["lm_head_q"], QuantizedWeight)
+
+    def test_quant_engine_tp2(self, eight_devices):
+        model, params = self._model()
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=256, block_size=32,
+                                weight_dtype="int8", mesh={"tp": 2})
+        prompt = np.random.default_rng(2).integers(0, 512, 32)
+        first = eng.put([1], [prompt])[1]
+        toks = eng.decode_batch([1], [int(np.argmax(first))], steps=4)[1]
+        assert toks.shape == (4,)
+
+
 def test_init_inference_checkpoint_surfaces(tmp_path, eight_devices):
     """init_inference(checkpoint=...) loads engine checkpoints (given the
     model) and HF checkpoint dirs (self-describing) — round-2 weak #7."""

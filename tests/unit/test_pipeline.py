@@ -57,6 +57,18 @@ def test_pipeline_with_zero(eight_devices):
     assert losses[-1] < losses[0]
 
 
+def test_pipeline_with_zero3_fsdp_tp(eight_devices):
+    """pp x fsdp x tp with ZeRO-3 (the dryrun's dense mesh): the per-tick
+    embedding gather must run over the once-replicated table — gathers over
+    an auto-fsdp-sharded operand inside the pp-manual region trip GSPMD's
+    group-math check (spmd_partitioner_util.cc:495 regression guard)."""
+    model = TransformerLM(get_preset("tiny"))
+    eng, *_ = ds.initialize(model=model, config=_cfg(
+        {"pp": 2, "fsdp": 2, "tp": 2}, zero_optimization={"stage": 3}))
+    losses = _train(eng, 3)
+    assert losses[-1] < losses[0]
+
+
 def test_pipeline_stage_divisibility():
     model = TransformerLM(get_preset("tiny"))  # 2 layers
     with pytest.raises(ValueError, match="divisible"):

@@ -419,3 +419,98 @@ class TestFPDT:
             pytest.skip("backend reports no memory analysis")
         assert p_f4 < 0.5 * p_x4, (p_f4, p_x4)     # far below dense scores
         assert p_f4 / p_f1 < 8, (p_f1, p_f4)       # ~linear, not quadratic
+
+
+class TestFPDTFusedBlock:
+    """Fused per-chunk-projection tier (sequence/fpdt.py
+    fpdt_block_attention; reference fpdt_layer.py:545 chunks the qkv
+    projections too): full-T q/k/v never materialize, forward or backward."""
+
+    @staticmethod
+    def _setup(T=256, D=64, H=4, K=2, chunk=64, dtype="float32"):
+        import dataclasses
+
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      TransformerLM)
+
+        cfg = dataclasses.replace(
+            TransformerConfig(arch="llama", vocab_size=64, hidden_size=D,
+                              num_layers=1, num_heads=H, num_kv_heads=K,
+                              max_seq_len=T, dtype=dtype,
+                              param_dtype="float32"),
+            attention_impl="fpdt", fpdt_chunk=chunk)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        w = jax.tree_util.tree_map(lambda p: p[0], params["layers"])["attn"]
+        r = np.random.default_rng(1)
+        x = jnp.asarray(r.normal(size=(2, T, D)).astype(np.float32))
+        return cfg, model._freqs, w, x
+
+    def test_matches_dense_block(self):
+        import dataclasses
+
+        from deepspeed_tpu.models.transformer import attention_block
+
+        cfg, freqs, w, x = self._setup()
+        out = jax.jit(lambda x, w: attention_block(
+            x, w, cfg, freqs, xla_attention))(x, w)
+        cfg_x = dataclasses.replace(cfg, attention_impl="xla")
+        ref = attention_block(x, w, cfg_x, freqs, xla_attention)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_grads_match_dense_block(self):
+        import dataclasses
+
+        from deepspeed_tpu.models.transformer import attention_block
+
+        cfg, freqs, w, x = self._setup()
+
+        def loss(x, w, c):
+            return jnp.sum(jnp.square(attention_block(
+                x, w, c, freqs, xla_attention)))
+
+        gx, gw = jax.jit(jax.grad(
+            lambda x, w: loss(x, w, cfg), argnums=(0, 1)))(x, w)
+        cfg_x = dataclasses.replace(cfg, attention_impl="xla")
+        rx, rw = jax.grad(lambda x, w: loss(x, w, cfg_x),
+                          argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   atol=2e-3, rtol=2e-3)
+        for key in rw:
+            np.testing.assert_allclose(np.asarray(gw[key]),
+                                       np.asarray(rw[key]),
+                                       atol=2e-3, rtol=2e-3, err_msg=key)
+
+    def test_no_full_t_qkv_resident(self):
+        """Training-step (fwd+bwd) peak of the fused path must undercut the
+        seam path (which materializes full-T q/k/v + their cotangents at the
+        projection boundary) and grow ~linearly in T."""
+        from deepspeed_tpu.models.transformer import (apply_rope,
+                                                      attention_block,
+                                                      attn_out_proj, qkv_proj)
+        from deepspeed_tpu.profiling import profile_fn
+        from deepspeed_tpu.sequence.fpdt import fpdt_attention
+
+        def peak(fn, T):
+            cfg, freqs, w, x = self._setup(T=T, D=256, H=4, K=2, chunk=256)
+            stats = profile_fn(lambda x, w: jax.grad(
+                lambda x: jnp.sum(jnp.square(fn(x, w, cfg, freqs))))(x), x, w)
+            return stats.get("peak_bytes", 0.0)
+
+        def fused(x, w, cfg, freqs):
+            return attention_block(x, w, cfg, freqs, xla_attention)
+
+        def seam(x, w, cfg, freqs):  # the pre-r4 path: full-T projections
+            q, k, v = qkv_proj(x, w, cfg)
+            q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+            out = fpdt_attention(q, k, v, causal=True,
+                                 chunk=cfg.fpdt_chunk, offload=False)
+            return attn_out_proj(out, w, cfg)
+
+        p_f1, p_f4 = peak(fused, 2048), peak(fused, 8192)
+        p_s4 = peak(seam, 8192)
+        if 0.0 in (p_f1, p_f4, p_s4):
+            pytest.skip("backend reports no memory analysis")
+        assert p_f4 < 0.75 * p_s4, (p_f4, p_s4)
+        assert p_f4 / p_f1 < 6, (p_f1, p_f4)
