@@ -138,9 +138,21 @@ def main() -> None:
 
     if os.environ.get("DSTPU_BENCH_INFERENCE", "1") != "0":
         try:
-            from bench_infer import run_inference_bench
+            # subprocess isolation: after the training section the chip no
+            # longer fits the serving engines in-process (ResourceExhausted)
+            import subprocess
 
-            result["extra"]["inference"] = run_inference_bench()
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_infer.py")],
+                capture_output=True, text=True, timeout=2400)
+            if r.returncode == 0 and r.stdout.strip():
+                data = json.loads(r.stdout.strip().splitlines()[-1])
+                data.pop("metric", None)
+                result["extra"]["inference"] = data
+            else:
+                result["extra"]["inference"] = {"error": r.stderr[-300:]}
         except Exception as e:  # serving bench must never sink the headline
             result["extra"]["inference"] = {"error": str(e)[:200]}
 
@@ -152,9 +164,19 @@ def main() -> None:
     # sync 14.2 s/step vs overlap 11.9 s/step — 16.6% of the stall hidden
     # (the tunnel's host<->device transfer cost dominates both modes here).
     if on_tpu and os.environ.get("DSTPU_BENCH_OFFLOAD", "0") == "1":
+        # subprocess isolation: the serving section leaves the chip too
+        # fragmented for three more engines in-process (ResourceExhausted)
         try:
-            result["extra"]["offload"] = bench_offload(ds, TransformerLM,
-                                                       TransformerConfig)
+            import subprocess
+
+            r = subprocess.run([sys.executable, __file__, "--offload"],
+                               capture_output=True, text=True, timeout=1200,
+                               env={**os.environ, "DSTPU_BENCH_OFFLOAD": "0"})
+            if r.returncode == 0 and r.stdout.strip():
+                result["extra"]["offload"] = json.loads(
+                    r.stdout.strip().splitlines()[-1])
+            else:
+                result["extra"]["offload"] = {"error": r.stderr[-300:]}
         except Exception as e:
             result["extra"]["offload"] = {"error": str(e)[:200]}
 
@@ -191,16 +213,50 @@ def bench_offload(ds, TransformerLM, TransformerConfig, steps: int = 5):
             loss = one_step()
         float(loss)                                # drain async work
         times[mode] = (time.perf_counter() - t0) / steps
+    # isolate the Adam-stall itself (the cost ZenFlow exists to hide):
+    # run the SAME csrc cpu_adam kernel on a same-sized flat shard. On this
+    # tunnel the host<->device transfers dominate both modes, so
+    # step_time_reduction understates the mechanism — stall_hidden_fraction
+    # reports how much of the pure host-Adam wall time the overlap removed
+    # from the step.
+    from deepspeed_tpu.offload.cpu_adam import DeepSpeedCPUAdam
+
+    n = int(cfg.num_params_estimate())
+    adam = DeepSpeedCPUAdam(lr=1e-4)
+    flat = np.zeros(n, np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m1 = np.zeros(n, np.float32)
+    m2 = np.zeros(n, np.float32)
+    adam.step(flat, g, m1, m2)                     # warm the omp pool
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        adam.step(flat, g, m1, m2)
+    host_adam_ms = (time.perf_counter() - t0) / steps * 1e3
+    saved_ms = (times["sync"] - times["overlap"]) * 1e3
     return {
         "sync_step_ms": round(times["sync"] * 1e3, 1),
         "overlap_step_ms": round(times["overlap"] * 1e3, 1),
-        # fraction of the WHOLE synchronous step saved by the overlap (the
-        # stall-only fraction would need a separately measured Adam time)
+        # fraction of the WHOLE synchronous step saved by the overlap
         "step_time_reduction": round(
             1.0 - times["overlap"] / times["sync"], 3),
+        "host_adam_ms": round(host_adam_ms, 1),
+        "stall_hidden_fraction": round(
+            max(0.0, min(saved_ms / host_adam_ms, 1.0)), 3)
+        if host_adam_ms > 0 else None,
         "model_params_m": round(cfg.num_params_estimate() / 1e6, 1),
     }
 
 
 if __name__ == "__main__":
-    main()
+    if "--offload" in sys.argv:
+        import json as _json
+
+        import numpy as np  # noqa: F811 — standalone entry
+
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+        print(_json.dumps(bench_offload(ds, TransformerLM,
+                                        TransformerConfig)))
+    else:
+        main()

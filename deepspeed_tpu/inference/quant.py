@@ -16,7 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                "wqkv", "w_gateup")
 
 
 def quantize_serving_params(params, cfg, bits: int, mesh):
@@ -44,6 +45,25 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
 
     with jax.sharding.set_mesh(mesh):
         layers = dict(params["layers"])
+        # fuse qkv and gate|up at the param level first (the model's
+        # qkv_proj/mlp_block consume the fused leaves): decode is a chain
+        # of small kernels, so three fewer launches per layer matter
+        attn = dict(layers["attn"])
+        if all(k in attn for k in ("wq", "wk", "wv")) \
+                and attn["wq"].ndim == 3:
+            attn["wqkv"] = jnp.concatenate(
+                [attn.pop("wq"), attn.pop("wk"), attn.pop("wv")], axis=-1)
+            if "bq" in attn:
+                attn["bqkv"] = jnp.concatenate(
+                    [attn.pop("bq"), attn.pop("bk"), attn.pop("bv")],
+                    axis=-1)
+        layers["attn"] = attn
+        mlp = dict(layers["mlp"])
+        if ("w_gate" in mlp and "w_up" in mlp and "b_up" not in mlp
+                and mlp["w_gate"].ndim == 3):  # MoE expert stacks stay split
+            mlp["w_gateup"] = jnp.concatenate(
+                [mlp.pop("w_gate"), mlp.pop("w_up")], axis=-1)
+        layers["mlp"] = mlp
         for grp in ("attn", "mlp"):
             sub = dict(layers[grp])
             for name in QUANT_LEAVES:

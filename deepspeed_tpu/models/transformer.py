@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -308,9 +308,51 @@ jax.tree_util.register_pytree_node(
     QuantizedWeight.tree_unflatten)
 
 
+def split_quant_leaves(layers: Params):
+    """Split a stacked layer tree into (dense-only tree, [(group, name,
+    stacked QuantizedWeight)]). Layer-scanned callers put only the dense
+    tree in scan xs and rebind the quant stacks per iteration as
+    :class:`QuantLayerRef` (see its docstring for why)."""
+    dense, quant = {}, []
+    for grp, sub in layers.items():
+        if isinstance(sub, dict):
+            dsub = {}
+            for name, leaf in sub.items():
+                if isinstance(leaf, QuantizedWeight):
+                    quant.append((grp, name, leaf))
+                else:
+                    dsub[name] = leaf
+            dense[grp] = dsub
+        else:
+            dense[grp] = sub
+    return dense, quant
+
+
+class QuantLayerRef(NamedTuple):
+    """(stacked :class:`QuantizedWeight`, traced layer index): ``linear``
+    runs the fused kernel over the FULL weight stack with the layer picked
+    by a scalar-prefetched BlockSpec index map. Layer-scanned decode paths
+    must use this instead of putting quant leaves in the scan xs — the
+    per-iteration dynamic-slice of an xs leaf cannot fuse into a Pallas
+    operand, so XLA materializes a copy of every packed layer every step
+    (measured ~13 ms/step on the 464M serving proxy, erasing the
+    quantization's bandwidth win)."""
+
+    qw: "QuantizedWeight"
+    layer: Any
+
+
 def linear(x: jax.Array, w) -> jax.Array:
-    """``x [..., Din] @ w`` where ``w`` is a dense array or a
-    :class:`QuantizedWeight` (fused dequant-matmul kernel)."""
+    """``x [..., Din] @ w`` where ``w`` is a dense array, a
+    :class:`QuantizedWeight`, or a :class:`QuantLayerRef` (fused
+    dequant-matmul kernel; stacked form for layer-scanned callers)."""
+    if isinstance(w, QuantLayerRef):
+        from deepspeed_tpu.ops.quant_matmul import quantized_matmul
+
+        lead = x.shape[:-1]
+        out = quantized_matmul(x.reshape(-1, w.qw.din), w.qw.packed,
+                               w.qw.scales, bits=w.qw.bits, layer=w.layer)
+        return out.reshape(*lead, out.shape[-1])
     if isinstance(w, QuantizedWeight):
         from deepspeed_tpu.ops.quant_matmul import quantized_matmul
 
@@ -323,12 +365,20 @@ def linear(x: jax.Array, w) -> jax.Array:
 
 def qkv_proj(x: jax.Array, w: Params, cfg: TransformerConfig):
     """Shared q/k/v projection (+ optional qwen-style biases) for every
-    forward path (train, dense decode, paged decode)."""
+    forward path (train, dense decode, paged decode). Serving engines may
+    install a fused ``wqkv`` [D, (H+2K)*hd] leaf (one kernel launch instead
+    of three — decode is a chain of small kernels)."""
     B, T = x.shape[0], x.shape[1]
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-    q, k, v = linear(x, w["wq"]), linear(x, w["wk"]), linear(x, w["wv"])
-    if "bq" in w:
-        q, k, v = q + w["bq"], k + w["bk"], v + w["bv"]
+    if "wqkv" in w:
+        qkv = linear(x, w["wqkv"])
+        if "bqkv" in w:
+            qkv = qkv + w["bqkv"]
+        q, k, v = jnp.split(qkv, [H * hd, (H + K) * hd], axis=-1)
+    else:
+        q, k, v = linear(x, w["wq"]), linear(x, w["wk"]), linear(x, w["wv"])
+        if "bq" in w:
+            q, k, v = q + w["bq"], k + w["bk"], v + w["bv"]
     return (q.reshape(B, T, H, hd), k.reshape(B, T, K, hd),
             v.reshape(B, T, K, hd))
 
@@ -383,6 +433,10 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
         else:
             out = xla_attention(q, k, v, causal=True,
                                 window=cfg.sliding_window)
+    elif cfg.attention_impl == "fpdt" and cfg.fpdt_chunk:
+        # the seam tier must honor the configured chunk too (the fused tier
+        # reads it inside fpdt_block_attention)
+        out = attn_fn(q, k, v, causal=True, chunk=cfg.fpdt_chunk)
     else:
         out = attn_fn(q, k, v, causal=True)
     o = attn_out_proj(out, w, cfg)
@@ -441,7 +495,12 @@ def mlp_block(x: jax.Array, w: Params, cfg: TransformerConfig) -> jax.Array:
 
         x = ste_quantize(x, bits=cfg.act_quant_bits)
     if cfg.activation == "swiglu":
-        h = jax.nn.silu(linear(x, w["w_gate"])) * linear(x, w["w_up"])
+        if "w_gateup" in w:  # serving-fused gate|up (one kernel launch)
+            gu = linear(x, w["w_gateup"])
+            g_half, u_half = jnp.split(gu, 2, axis=-1)
+            h = jax.nn.silu(g_half) * u_half
+        else:
+            h = jax.nn.silu(linear(x, w["w_gate"])) * linear(x, w["w_up"])
     else:
         # gelu = tanh-approx (HF gelu_new/gelu_pytorch_tanh, gpt2 family);
         # gelu_exact = erf gelu (HF "gelu": falcon/gpt-neox); relu = opt
@@ -873,12 +932,16 @@ class TransformerLM:
             x = x + params["embed"]["pos"][positions].astype(dt)
         freqs = self._freqs
 
+        dense_layers, quant_items = split_quant_leaves(params["layers"])
+
         def make_body(cseg):
             def body(carry, xs):
-                layer_w, ck, cv = xs
+                layer_w, ck, cv, li = xs
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
+                for grp, name, qw in quant_items:
+                    wc[grp] = {**wc[grp], name: QuantLayerRef(qw, li)}
                 new_kv = {}
 
                 def attn_cache_fn(q, k, v):
@@ -903,8 +966,9 @@ class TransformerLM:
         nk_parts, nv_parts = [], []
         for lo, hi, cseg in self._window_segments():
             seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
-                                             params["layers"]),
-                      cache["k"][lo:hi], cache["v"][lo:hi])
+                                             dense_layers),
+                      cache["k"][lo:hi], cache["v"][lo:hi],
+                      jnp.arange(lo, hi, dtype=jnp.int32))
             x, (nk, nv) = jax.lax.scan(make_body(cseg), x, seg_xs)
             nk_parts.append(nk)
             nv_parts.append(nv)
@@ -977,12 +1041,16 @@ class TransformerLM:
 
         K, hd = cfg.num_kv_heads, cfg.head_dim
 
+        dense_layers, quant_items = split_quant_leaves(params["layers"])
+
         def make_body(cseg):
             def body(carry, xs):
-                layer_w, kp, vp = xs
+                layer_w, kp, vp, li = xs
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
+                for grp, name, qw in quant_items:
+                    wc[grp] = {**wc[grp], name: QuantLayerRef(qw, li)}
                 new_kv = {}
                 # legacy escape-hatch path: unfold the lane-folded pool per
                 # layer (a relayout copy — the packed path avoids this)
@@ -1006,8 +1074,9 @@ class TransformerLM:
         nk_parts, nv_parts = [], []
         for lo, hi, cseg in self._window_segments():
             seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
-                                             params["layers"]),
-                      cache["k"][lo:hi], cache["v"][lo:hi])
+                                             dense_layers),
+                      cache["k"][lo:hi], cache["v"][lo:hi],
+                      jnp.arange(lo, hi, dtype=jnp.int32))
             x, (nk, nv) = jax.lax.scan(make_body(cseg), x, seg_xs)
             nk_parts.append(nk)
             nv_parts.append(nv)
@@ -1081,12 +1150,16 @@ class TransformerLM:
             a_len_t = valid[dr:].reshape(n_tiles, tile_tq).sum(
                 axis=1, dtype=jnp.int32)
 
+        dense_layers, quant_items = split_quant_leaves(params["layers"])
+
         def make_body(cseg):
             def body(carry, xs):
                 layer_w, li = xs
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
+                for grp, name, qw in quant_items:
+                    wc[grp] = {**wc[grp], name: QuantLayerRef(qw, li)}
                 new_kv = {}
 
                 def attn_cache_fn(q, k, v):
@@ -1123,7 +1196,7 @@ class TransformerLM:
         kr_parts, vr_parts = [], []
         for lo, hi, cseg in self._window_segments():
             seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
-                                             params["layers"]),
+                                             dense_layers),
                       jnp.arange(lo, hi, dtype=jnp.int32))
             x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_xs)
             kr_parts.append(kr)
@@ -1179,11 +1252,16 @@ class TransformerLM:
         freqs = self._freqs
         attn_fn = get_attention_impl(cfg.attention_impl)
 
+        dense_layers, quant_items = split_quant_leaves(params["layers"])
+
         def make_body(cseg):
-            def body(carry, layer_w):
+            def body(carry, xs):
+                layer_w, li = xs
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
+                for grp, name, qw in quant_items:
+                    wc[grp] = {**wc[grp], name: QuantLayerRef(qw, li)}
                 kv = {}
 
                 def attn_cache_fn(q, k, v):
@@ -1206,9 +1284,10 @@ class TransformerLM:
 
         kr_parts, vr_parts = [], []
         for lo, hi, cseg in self._window_segments():
-            seg_layers = jax.tree_util.tree_map(lambda p: p[lo:hi],
-                                                params["layers"])
-            x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_layers)
+            seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
+                                             dense_layers),
+                      jnp.arange(lo, hi, dtype=jnp.int32))
+            x, (kr, vr) = jax.lax.scan(make_body(cseg), x, seg_xs)
             kr_parts.append(kr)
             vr_parts.append(vr)
         kr = kr_parts[0] if len(kr_parts) == 1 else jnp.concatenate(kr_parts)
@@ -1262,6 +1341,8 @@ class TransformerLM:
         freqs = self._freqs
         scale = 1.0 / math.sqrt(hd)
 
+        dense_layers, quant_items = split_quant_leaves(params["layers"])
+
         def make_body(cseg):
             def body(carry, xs):
                 h, tk, tv = carry
@@ -1269,6 +1350,8 @@ class TransformerLM:
                 wc = jax.tree_util.tree_map(
                     lambda p: p.astype(dt) if p.dtype == jnp.float32 else p,
                     layer_w)
+                for grp, name, qw in quant_items:
+                    wc[grp] = {**wc[grp], name: QuantLayerRef(qw, li)}
                 box = {}
 
                 def attn_cache_fn(q, k, v):
@@ -1324,7 +1407,7 @@ class TransformerLM:
         tk, tv = tail["k"], tail["v"]
         for lo, hi, cseg in self._window_segments():
             seg_xs = (jax.tree_util.tree_map(lambda p: p[lo:hi],
-                                             params["layers"]),
+                                             dense_layers),
                       jnp.arange(lo, hi, dtype=jnp.int32))
             (x, tk, tv), _ = jax.lax.scan(make_body(cseg), (x, tk, tv),
                                           seg_xs)

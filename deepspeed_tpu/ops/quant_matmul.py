@@ -56,8 +56,7 @@ def quantize_matmul_weight(w: jax.Array, bits: int = 4, group: int = 128
     return packed.reshape(D // 2, F), scale
 
 
-def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bits: int, group: int,
-                n_g: int):
+def _qmm_body(x, q_all, s_all, *, bits: int, group: int, n_g: int):
     # whole contraction dim per f-block: ONE [D/2(, D), bf]-sized DMA and ONE
     # MXU dot per grid step. A (f, group)-blocked grid issued ~32 KB weight
     # DMAs, which stream far below the rate big XLA dots reach — the packed
@@ -66,8 +65,8 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bits: int, group: int,
     rows = group // 2 if bits == 4 else group
     tiles = []
     for g in range(n_g):                    # static unroll over groups
-        q = q_ref[g * rows:(g + 1) * rows, :]    # int8 [rows, bf]
-        s = s_ref[g]                             # fp32 [1, bf]
+        q = q_all[g * rows:(g + 1) * rows, :]    # int8 [rows, bf]
+        s = s_all[g:g + 1].astype(jnp.float32)   # [1, bf] (stored bf16/f32)
         if bits == 4:
             # nibble unpack in float arithmetic: Mosaic does not legalize
             # int8 vector shifts (arith.shli), and -128..127 is exact in fp32
@@ -82,19 +81,43 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bits: int, group: int,
             wt = q.astype(jnp.float32)
         tiles.append((wt * s).astype(jnp.bfloat16))
     w_full = jnp.concatenate(tiles, axis=0)      # bf16 [D, bf]
-    o_ref[:] = jax.lax.dot_general(
-        x_ref[:], w_full, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    return jax.lax.dot_general(
+        x, w_full, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bits: int, group: int,
+                n_g: int):
+    o_ref[:] = _qmm_body(x_ref[:], q_ref[:], s_ref[:], bits=bits,
+                         group=group, n_g=n_g).astype(o_ref.dtype)
+
+
+def _qmm_stacked_kernel(li_ref, x_ref, q_ref, s_ref, o_ref, *, bits: int,
+                        group: int, n_g: int):
+    # stacked form: the layer is picked by the scalar-prefetched BlockSpec
+    # index maps; refs carry a leading singleton layer dim
+    del li_ref
+    o_ref[:] = _qmm_body(x_ref[:], q_ref[0], s_ref[0], bits=bits,
+                         group=group, n_g=n_g).astype(o_ref.dtype)
 
 
 def quantized_matmul(x: jax.Array, packed: jax.Array, scales: jax.Array,
                      bits: int = 4, block_f: int = 512,
-                     interpret: bool = None) -> jax.Array:
+                     interpret: bool = None, layer=None) -> jax.Array:
     """``x`` [B, D] @ dequant(packed, scales) → [B, F], weights expanded only
     in VMEM. Falls back to the XLA dequant-then-matmul outside the kernel's
-    sweet spot (tiny shapes, large activation batches, non-TPU geometries)."""
+    sweet spot (tiny shapes, large activation batches, non-TPU geometries).
+
+    With ``layer`` (a traced scalar), ``packed``/``scales`` are the FULL
+    [L, ...] stacks and the layer is picked inside the kernel by
+    scalar-prefetched BlockSpec index maps — a layer-scanned caller must NOT
+    dynamic-slice the stacks per iteration (Pallas operands cannot fuse the
+    slice, so XLA materializes a copy of every packed layer every step)."""
     if interpret is None:
         interpret = not _on_tpu()
+    if layer is not None:
+        return _quantized_matmul_stacked(x, packed, scales, bits, block_f,
+                                         interpret, layer)
     B, D = x.shape
     G, F = scales.shape
     group = D // G
@@ -122,13 +145,56 @@ def quantized_matmul(x: jax.Array, packed: jax.Array, scales: jax.Array,
         in_specs=[
             pl.BlockSpec((B, D), lambda f: (0, 0)),
             pl.BlockSpec((G * rows, bf), lambda f: (0, f)),
-            pl.BlockSpec((G, 1, bf), lambda f: (0, 0, f)),
+            pl.BlockSpec((G, bf), lambda f: (0, f)),
         ],
         out_specs=pl.BlockSpec((B, bf), lambda f: (0, f)),
         out_shape=jax.ShapeDtypeStruct((B, F), x.dtype),
         interpret=interpret,
-    )(x, packed, scales.astype(jnp.float32).reshape(G, 1, F))
+    )(x, packed, scales)
     return out
+
+
+def _quantized_matmul_stacked(x, packed, scales, bits, block_f, interpret,
+                              layer):
+    B, D = x.shape
+    L, G, F = scales.shape
+    group = D // G
+    rows = group // 2 if bits == 4 else group
+    assert packed.shape[1] == G * rows, (packed.shape, G, rows)
+
+    def _fallback():
+        pl_ = jax.lax.dynamic_index_in_dim(packed, layer, 0, keepdims=False)
+        sl_ = jax.lax.dynamic_index_in_dim(scales, layer, 0, keepdims=False)
+        return x @ dequantize_matmul_weight(pl_, sl_, bits, D)
+
+    if D % 128 or F % 128 or group % 128 or B > 256:
+        return _fallback()
+    bf = min(block_f, F)
+    while F % bf:
+        bf //= 2
+    x_bytes = B * D * x.dtype.itemsize
+    while bf > 128 and D * bf * 3 + x_bytes > 10 * 1024 * 1024:
+        bf //= 2
+    if bf % 128 or D * bf * 3 + x_bytes > 12 * 1024 * 1024:
+        return _fallback()
+    kernel = functools.partial(_qmm_stacked_kernel, bits=bits, group=group,
+                               n_g=G)
+    li = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(F // bf,),
+        in_specs=[
+            pl.BlockSpec((B, D), lambda f, li: (0, 0)),
+            pl.BlockSpec((1, G * rows, bf), lambda f, li: (li[0], 0, f)),
+            pl.BlockSpec((1, G, bf), lambda f, li: (li[0], 0, f)),
+        ],
+        out_specs=pl.BlockSpec((B, bf), lambda f, li: (0, f)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, F), x.dtype),
+        interpret=interpret,
+    )(li, x, packed, scales)
 
 
 def dequantize_matmul_weight(packed: jax.Array, scales: jax.Array,

@@ -656,8 +656,32 @@ class TestWeightQuantServing:
         assert out.shape == (1, 20)
         from deepspeed_tpu.models.transformer import QuantizedWeight
 
-        assert isinstance(eng.params["layers"]["attn"]["wq"], QuantizedWeight)
+        assert isinstance(eng.params["layers"]["attn"]["wqkv"],
+                          QuantizedWeight)
         assert isinstance(eng.params["lm_head_q"], QuantizedWeight)
+
+    def test_moe_model_quant_serves(self):
+        """MoE expert stacks ([L, E, D, F]) must NOT be gate|up-fused or
+        quantized — the attn stack still quantizes; the expert FFNs stay
+        dense and the MoE dispatch path keeps its leaf names."""
+        from deepspeed_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=512, hidden_size=128,
+                                num_layers=2, num_heads=4, max_seq_len=256,
+                                arch="llama", num_experts=4, top_k=2)
+        model = TransformerLM(cfg)
+        eng = InferenceEngineV2(model, params=model.init(jax.random.key(0)),
+                                max_sequences=4, max_seq_len=256,
+                                block_size=32, weight_dtype="int8")
+        from deepspeed_tpu.models.transformer import QuantizedWeight
+
+        assert isinstance(eng.params["layers"]["attn"]["wqkv"],
+                          QuantizedWeight)
+        assert "w_gateup" not in eng.params["layers"]["mlp"]
+        prompt = np.random.default_rng(4).integers(0, 512, 40)
+        first = eng.put([1], [prompt])[1]
+        toks = eng.decode_batch([1], [int(np.argmax(first))], steps=4)[1]
+        assert toks.shape == (4,)
 
     def test_quant_engine_tp2(self, eight_devices):
         model, params = self._model()
