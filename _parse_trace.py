@@ -1,0 +1,14 @@
+import glob, gzip, json, collections
+path = sorted(glob.glob("/tmp/decode_trace/**/*.trace.json.gz", recursive=True))[-1]
+ev = json.loads(gzip.open(path).read())["traceEvents"]
+pids = {}
+for e in ev:
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+        pids[e["pid"]] = e["args"].get("name", "")
+print("processes:", pids)
+tot = collections.Counter(); cnt = collections.Counter()
+for e in ev:
+    if e.get("ph") == "X" and "dur" in e and "TPU" in pids.get(e.get("pid"), ""):
+        tot[e.get("name", "")[:70]] += e["dur"]; cnt[e.get("name", "")[:70]] += 1
+for k, v in tot.most_common(25):
+    print(f"{v/1e3:9.2f} ms  x{cnt[k]:<5d} {k}")

@@ -1,0 +1,42 @@
+"""Profile one fused decode_batch @occ32 int8kv+int8w: where does the step go?"""
+import glob
+import gzip
+import json
+import os
+
+import numpy as np
+import jax
+
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+cfg = TransformerConfig(vocab_size=32000, hidden_size=1536, num_layers=16,
+                        num_heads=12, num_kv_heads=6, max_seq_len=4096)
+model = TransformerLM(cfg)
+params = jax.jit(model.init)(jax.random.key(0))
+eng = InferenceEngineV2(model, params=params, max_sequences=32,
+                        max_seq_len=648, block_size=128,
+                        kv_dtype="int8", weight_dtype="int8")
+rng = np.random.default_rng(0)
+uids = list(range(32))
+for i in range(0, 32, 16):
+    grp = uids[i:i + 16]
+    eng.put(grp, [rng.integers(0, 32000, 512) for _ in grp])
+toks = [0] * 32
+eng.decode_batch(uids, toks, steps=16)      # warmup/compile
+with jax.profiler.trace("/tmp/decode_trace"):
+    eng.decode_batch(uids, toks, steps=16)
+
+# parse: sum device durations by op name prefix
+path = sorted(glob.glob("/tmp/decode_trace/**/*.trace.json.gz",
+                        recursive=True))[-1]
+ev = json.loads(gzip.open(path).read())["traceEvents"]
+tot = {}
+for e in ev:
+    if e.get("ph") == "X" and "dur" in e:
+        name = e.get("name", "")
+        pid_name = e.get("pid")
+        key = name.split(".")[0].split("(")[0][:46]
+        tot[key] = tot.get(key, 0) + e["dur"]
+for k, v in sorted(tot.items(), key=lambda kv: -kv[1])[:24]:
+    print(f"{v/1e3:9.2f} ms  {k}")

@@ -97,16 +97,24 @@ class InferenceEngineV2:
         self.params = params
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
-        if kv_dtype not in ("bf16", "int8"):
-            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got "
+        if kv_dtype not in ("bf16", "int8", "int4"):
+            raise ValueError(f"kv_dtype must be bf16|int8|int4, got "
                              f"{kv_dtype!r}")
         self.kv_dtype = kv_dtype
-        if kv_dtype == "int8" and not (paged and packed):
-            raise ValueError("int8 KV needs the packed paged engine")
+        if kv_dtype != "bf16" and not (paged and packed):
+            raise ValueError("quantized KV needs the packed paged engine")
+        if kv_dtype == "int4" and "tp" in self.mesh.axis_names \
+                and self.mesh.shape["tp"] > 1:
+            # the int4 pool's byte lanes pair feature j with j + K*d/2
+            # (the only Mosaic-lowerable pairing), so lane-sharding it over
+            # tp would split pairs across shards
+            raise ValueError("kv_dtype='int4' does not compose with tp>1 "
+                             "(use int8 KV under tensor parallelism)")
         if paged:
             self.num_blocks = self.state.allocator.num_blocks
-            cache = model.init_paged_kv_cache(self.num_blocks, block_size,
-                                              quantize=kv_dtype == "int8")
+            cache = model.init_paged_kv_cache(
+                self.num_blocks, block_size, quantize=kv_dtype != "bf16",
+                bits=4 if kv_dtype == "int4" else 8)
             # pool sharded over tp on the lane-folded kv-head dim
             # ([L, nb+1, bs, K*d]: contiguous d-lanes per kv head);
             # per-token int8 scales replicated (identical on every shard)
@@ -238,11 +246,12 @@ class InferenceEngineV2:
                 + jnp.arange(steps, dtype=pos0.dtype)[None, :]).reshape(-1)
         valid2 = jnp.repeat(valid, steps)
         if "kv_scale" in cache:
+            kvb = 4 if self.kv_dtype == "int4" else 8
             nk, sc1 = packed_kv_append_quant(cache["k"], cache["kv_scale"],
                                              rows_k, bt, slot2, pos2, 0,
-                                             valid2)
+                                             valid2, bits=kvb)
             nv, sc2 = packed_kv_append_quant(cache["v"], sc1, rows_v, bt,
-                                             slot2, pos2, 1, valid2)
+                                             slot2, pos2, 1, valid2, bits=kvb)
             return out, {"k": nk, "v": nv, "kv_scale": sc2}
         nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
         nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)
@@ -303,17 +312,18 @@ class InferenceEngineV2:
         L = kv["k"].shape[0]
         Bp, T = ids.shape
         K, hd = self.cfg.num_kv_heads, self.cfg.head_dim
-        rows_k = kv["k"].reshape(L, Bp * T, K * hd)
-        rows_v = kv["v"].reshape(L, Bp * T, K * hd)
+        rows_k = kv["k"].reshape(L, Bp * T, K, hd)
+        rows_v = kv["v"].reshape(L, Bp * T, K, hd)
         slot2 = jnp.repeat(slots, T)
         pos2 = jnp.tile(jnp.arange(T, dtype=jnp.int32), Bp)
         valid2 = (jnp.arange(T)[None, :] < lengths[:, None]).reshape(-1)
         if "kv_scale" in cache:
+            kvb = 4 if self.kv_dtype == "int4" else 8
             nk, sc1 = packed_kv_append_quant(cache["k"], cache["kv_scale"],
                                              rows_k, bt, slot2, pos2, 0,
-                                             valid2)
+                                             valid2, bits=kvb)
             nv, sc2 = packed_kv_append_quant(cache["v"], sc1, rows_v, bt,
-                                             slot2, pos2, 1, valid2)
+                                             slot2, pos2, 1, valid2, bits=kvb)
             return logits, {"k": nk, "v": nv, "kv_scale": sc2}
         nk = packed_kv_append(cache["k"], rows_k, bt, slot2, pos2, valid2)
         nv = packed_kv_append(cache["v"], rows_v, bt, slot2, pos2, valid2)

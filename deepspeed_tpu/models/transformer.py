@@ -982,7 +982,8 @@ class TransformerLM:
     # ---- paged decode path (blocked KV pool) ------------------------------
     def init_paged_kv_cache(self, num_blocks: int, block_size: int = 128,
                             dtype: Optional[Any] = None,
-                            quantize: bool = False) -> Dict[str, jax.Array]:
+                            quantize: bool = False,
+                            bits: int = 8) -> Dict[str, jax.Array]:
         """Allocate the global blocked KV pool (inference v2 kv_cache.py parity):
         ``[L, num_blocks+1, block_size, K*d]`` — the last block is scratch for
         padded lanes. HBM is proportional to ``num_blocks``, not
@@ -994,14 +995,19 @@ class TransformerLM:
         it at every Pallas read (measured ~1.8 ms x layers x steps on v5e).
         Folding at allocation makes the kernels' DMA view the storage view.
 
-        ``quantize=True`` allocates int8 pools plus a per-token dequant
+        ``quantize=True`` allocates int pools plus a per-token dequant
         scale array ``kv_scale`` [L, nb+1, 1, 2*block_size] (k scales in lanes
-        [0, bs), v in [bs, 2bs)) — KV HBM traffic halves, which is the
+        [0, bs), v in [bs, 2bs)) — KV HBM traffic halves (int8) or quarters
+        (``bits=4``: lane j paired with j + K*d/2 per byte), which is the
         decode bound on a bandwidth-limited chip."""
         cfg = self.cfg
         dt = jnp.dtype(dtype or cfg.dtype)
-        shape = (cfg.num_layers, num_blocks + 1, block_size,
-                 cfg.num_kv_heads * cfg.head_dim)
+        lanes = cfg.num_kv_heads * cfg.head_dim
+        if quantize and bits == 4:
+            if cfg.head_dim % 2:
+                raise ValueError("int4 KV needs an even head_dim")
+            lanes //= 2
+        shape = (cfg.num_layers, num_blocks + 1, block_size, lanes)
         if quantize:
             return {"k": jnp.zeros(shape, jnp.int8),
                     "v": jnp.zeros(shape, jnp.int8),
@@ -1175,13 +1181,14 @@ class TransformerLM:
                             q2[:dr], k2[:dr], v2[:dr], cache["k"], cache["v"],
                             block_tables, a_slot_d, a_pos_d, a_len_d, tq=1,
                             window=cseg.sliding_window, layer=li,
-                            kv_scale=kv_scale))
+                            kv_scale=kv_scale, kv_bits=self._kv_bits(cache)))
                     if n_tiles:
                         parts.append(ragged_paged_attention_tp(
                             q2[dr:], k2[dr:], v2[dr:], cache["k"], cache["v"],
                             block_tables, a_slot_t, a_pos_t, a_len_t,
                             tq=tile_tq, window=cseg.sliding_window, layer=li,
-                            no_past=tiles_no_past, kv_scale=kv_scale))
+                            no_past=tiles_no_past, kv_scale=kv_scale,
+                            kv_bits=self._kv_bits(cache)))
                     out = (parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))
                     return out[:, None]                         # [N, 1, H, d]
@@ -1204,12 +1211,13 @@ class TransformerLM:
         krows = kr_parts[0] if len(kr_parts) == 1 else jnp.concatenate(kr_parts)
         vrows = vr_parts[0] if len(vr_parts) == 1 else jnp.concatenate(vr_parts)
         if kv_scale is not None:
+            kvb = self._kv_bits(cache)
             nk, sc1 = packed_kv_append_quant(cache["k"], kv_scale, krows,
                                              block_tables, tok_slot, tok_pos,
-                                             0, valid)
+                                             0, valid, bits=kvb)
             nv, sc2 = packed_kv_append_quant(cache["v"], sc1, vrows,
                                              block_tables, tok_slot, tok_pos,
-                                             1, valid)
+                                             1, valid, bits=kvb)
             new_cache = {"k": nk, "v": nv, "kv_scale": sc2}
         else:
             nk = packed_kv_append(cache["k"], krows, block_tables, tok_slot,
@@ -1298,6 +1306,13 @@ class TransformerLM:
         logits = self._head_proj(params, xg)
         return logits, {"k": kr, "v": vr}
 
+    def _kv_bits(self, cache) -> int:
+        """4 when the paged pool is int4-packed (lane dim K*d/2), else 8."""
+        if "kv_scale" not in cache:
+            return 8
+        half = self.cfg.num_kv_heads * self.cfg.head_dim // 2
+        return 4 if cache["k"].shape[-1] == half else 8
+
     def forward_decode_tail(self, params: Params, toks: jax.Array,
                             cache: Dict[str, jax.Array],
                             tail: Dict[str, jax.Array], t: jax.Array,
@@ -1360,7 +1375,8 @@ class TransformerLM:
                     acc, m_k, l_k = decode_pool_partials_tp(
                         q2, cache["k"], cache["v"], li, block_tables, slots,
                         pos_base, window=window, row_pos=row_pos,
-                        kv_scale=cache.get("kv_scale"))
+                        kv_scale=cache.get("kv_scale"),
+                        kv_bits=self._kv_bits(cache))
                     # append self into the tail, then attend tail cols <= t
                     tk2 = jax.lax.dynamic_update_slice(
                         tk, k2[None, :, None].astype(tk.dtype),

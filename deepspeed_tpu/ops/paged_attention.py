@@ -342,8 +342,27 @@ def _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window):
     return pos0, lo.astype(jnp.int32), ng
 
 
+def _unpack_int4_lanes(packed_f32, K: int, d: int):
+    """[R, K*d/2] float-valued packed bytes → [R, K*d] int4 values as f32.
+
+    Lane pairing is GLOBAL — byte lane j holds features j (low nibble) and
+    j + K*d/2 (high) — so the unpack is one 128-aligned lane concat
+    (per-head pairing would need d/2-lane slices, which Mosaic will not
+    lower; the cost is that an int4 pool cannot be lane-sharded over tp —
+    the engine guards that combination). Float arithmetic because Mosaic
+    does not legalize int8 vector shifts (see ops/quant_matmul.py)."""
+    del K, d
+    u = packed_f32 + 256.0 * (packed_f32 < 0)
+    hi = jnp.floor(u / 16.0)
+    lo = u - 16.0 * hi
+    lo = lo - 16.0 * (lo >= 8)
+    hi = hi - 16.0 * (hi >= 8)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
 def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
-                   nb_max: int, NG: int, window, quantized: bool):
+                   nb_max: int, NG: int, window, quantized: bool,
+                   kv_bits: int = 8):
     """One work item = G consecutive past-KV blocks of one decode atom."""
     if quantized:
         (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref, bt_ref,
@@ -358,7 +377,7 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
     n_items = pl.num_programs(0)
     G, DEPTH = _DECODE_G, _DMA_DEPTH
     H = q_ref.shape[1]
-    d = kpool.shape[-1] // K
+    d = q_ref.shape[2] // K       # NOT from the pool: int4 packs its lanes
     a = i // NG
     g = jax.lax.rem(i, NG)
     item_dmas, item_active = _worklist_helpers(
@@ -387,9 +406,15 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
         for c in item_dmas(i, dst):
             c.wait()
         qb = q_ref[0]                            # [H, K*d] zero-padded
-        if quantized:                 # int8 rows, per-token dequant scales
-            kb = kbuf[dst].astype(qb.dtype)
-            vb = vbuf[dst].astype(qb.dtype)
+        if quantized:                 # int rows, per-token dequant scales
+            if kv_bits == 4:          # nibble-unpack per-head lane slabs
+                kb = _unpack_int4_lanes(
+                    kbuf[dst].astype(jnp.float32), K, d).astype(qb.dtype)
+                vb = _unpack_int4_lanes(
+                    vbuf[dst].astype(jnp.float32), K, d).astype(qb.dtype)
+            else:
+                kb = kbuf[dst].astype(qb.dtype)
+                vb = vbuf[dst].astype(qb.dtype)
             sc = sbuf[pl.ds(dst * G, G), 0]      # [G, 2*bs] f32
             sck = sc[:, :bs].reshape(1, G * bs)
             scv = sc[:, bs:].reshape(1, G * bs)
@@ -443,18 +468,20 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
 
 def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
                          atom_pos0, *, window=None, row_pos=None,
-                         interpret=None, kv_scale=None):
+                         interpret=None, kv_scale=None, kv_bits: int = 8):
     """(acc, m, l) flash-decode partials of each decode row's attention over
     its POOL-cached past (positions < pos0). ``row_pos`` is the query's true
     position (defaults to pos0) — it only matters for sliding windows, e.g.
     in the fused loop where rows advance while the pool frontier stays put.
     q [A, H, d]; pools STACKED lane-folded [L, nbp1, bs, K*d] — bf16, or
-    int8 with ``kv_scale`` [L, nbp1, 1, 2*bs] per-token dequant scales.
+    int8/int4 (``kv_bits``; int4 packs lane j with j + K*d/2 per byte) with
+    ``kv_scale`` [L, nbp1, 1, 2*bs] per-token dequant scales.
     Returns fp32 acc [A, H, d] (unnormalized), m/l [A, H]."""
     if interpret is None:
         interpret = not _on_tpu()
     A, H, d = q.shape
-    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    lane_mul = 2 if (kv_scale is not None and kv_bits == 4) else 1
+    bs, K = k_pool.shape[2], k_pool.shape[3] * lane_mul // d
     rep = H // K
     nb_max = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d)
@@ -464,7 +491,8 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     if not interpret and (d % 128 or bs % 8):
         return xla_decode_partials(q, k_pool, v_pool, layer, block_tables,
                                    atom_slot, atom_pos0, window=window,
-                                   row_pos=row_pos, kv_scale=kv_scale)
+                                   row_pos=row_pos, kv_scale=kv_scale,
+                                   kv_bits=kv_bits)
     G = _DECODE_G
     NG = max(1, -(-nb_max // G))
     pos0, lo, ng = _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window)
@@ -478,15 +506,16 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, bs=bs, K=K, rep=rep, nb_max=nb_max,
-        NG=NG, window=window, quantized=quantized)
+        NG=NG, window=window, quantized=quantized, kv_bits=kv_bits)
+    kd_lanes = k_pool.shape[3]          # K*d, or K*d/2 for the int4 pool
     in_specs = [
         pl.BlockSpec((1, H, K * d), lambda i, *_: (i // NG, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
     scratch = [
-        pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
-        pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+        pltpu.VMEM((_DMA_DEPTH, G * bs, kd_lanes), k_pool.dtype),
+        pltpu.VMEM((_DMA_DEPTH, G * bs, kd_lanes), v_pool.dtype),
         pltpu.SemaphoreType.DMA((_DMA_DEPTH, 3 if quantized else 2, G)),
         pltpu.VMEM((H, 128), jnp.float32),
         pltpu.VMEM((H, 128), jnp.float32),
@@ -523,13 +552,24 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     return acc, m_p[..., 0], l_p[..., 0]
 
 
+def _unpack_int4_lanes_xla(packed, K: int, d: int):
+    """[..., K*d/2] int8 packed → [..., K*d] f32 int4 values (XLA-side twin
+    of :func:`_unpack_int4_lanes`, same global lane pairing; int8 shifts
+    are fine outside Mosaic)."""
+    del K, d
+    lo = ((packed << 4).astype(jnp.int8) >> 4).astype(jnp.float32)
+    hi = (packed >> 4).astype(jnp.float32)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
 def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
                         atom_pos0, *, window=None, row_pos=None,
-                        kv_scale=None):
+                        kv_scale=None, kv_bits: int = 8):
     """Dense-gather reference/fallback for :func:`decode_pool_partials`
     (pools stacked lane-folded [L, nbp1, bs, K*d])."""
     A, H, d = q.shape
-    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    lane_mul = 2 if (kv_scale is not None and kv_bits == 4) else 1
+    bs, K = k_pool.shape[2], k_pool.shape[3] * lane_mul // d
     rep = H // K
     if row_pos is None:
         row_pos = atom_pos0
@@ -537,9 +577,13 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
     bt = block_tables[atom_slot]                            # [A, nb_max]
     S = bt.shape[1] * bs
-    kd = kp[bt].reshape(A, S, K, d)
-    vd = vp[bt].reshape(A, S, K, d)
-    if kv_scale is not None:                    # int8 pool: dequant per token
+    if kv_scale is not None and kv_bits == 4:
+        kd = _unpack_int4_lanes_xla(kp[bt], K, d).reshape(A, S, K, d)
+        vd = _unpack_int4_lanes_xla(vp[bt], K, d).reshape(A, S, K, d)
+    else:
+        kd = kp[bt].reshape(A, S, K, d)
+        vd = vp[bt].reshape(A, S, K, d)
+    if kv_scale is not None:                    # int pool: dequant per token
         sc = jax.lax.dynamic_index_in_dim(kv_scale, layer, keepdims=False)
         sc = sc[bt][..., 0, :]                  # [A, nb_max, 2*bs]
         sck = sc[..., :bs].reshape(A, S)
@@ -566,7 +610,8 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
 
 def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
                             atom_slot, atom_pos0, axis: str = "tp",
-                            window=None, row_pos=None, kv_scale=None):
+                            window=None, row_pos=None, kv_scale=None,
+                            kv_bits: int = 8):
     """Tensor-parallel :func:`decode_pool_partials` (heads embarrassingly
     parallel: q on H, pools on K, partials out on H; per-token int8 scales
     replicated)."""
@@ -577,7 +622,8 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
             or mesh.shape[axis] <= 1:
         return decode_pool_partials(q, k_pool, v_pool, layer, block_tables,
                                     atom_slot, atom_pos0, window=window,
-                                    row_pos=row_pos, kv_scale=kv_scale)
+                                    row_pos=row_pos, kv_scale=kv_scale,
+                                    kv_bits=kv_bits)
     if row_pos is None:
         row_pos = atom_pos0
 
@@ -591,7 +637,7 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
     def shard_fn(q, kp, vp, lay, bt, a_s, a_p, rp, sc):
         return decode_pool_partials(
             q, kp, vp, lay, bt, a_s, a_p, window=window, row_pos=rp,
-            kv_scale=sc if sc.ndim == 4 else None)
+            kv_scale=sc if sc.ndim == 4 else None, kv_bits=kv_bits)
 
     return jax.shard_map(
         shard_fn,
@@ -608,7 +654,7 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
 
 def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
                       atom_slot, atom_pos0, atom_len, *, window, interpret,
-                      kv_scale=None):
+                      kv_scale=None, kv_bits: int = 8):
     """Decode-row attention: pool partials + self token merged outside
     (flash-decode split reduction). Shapes: q/k_self/v_self [A, H|K, d];
     pools STACKED lane-folded [L, nbp1, bs, K*d], ``layer`` picks the
@@ -619,7 +665,8 @@ def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
     scale = 1.0 / math.sqrt(d)
     acc, m_k, l_k = decode_pool_partials(
         q, k_pool, v_pool, layer, block_tables, atom_slot, atom_pos0,
-        window=window, interpret=interpret, kv_scale=kv_scale)
+        window=window, interpret=interpret, kv_scale=kv_scale,
+        kv_bits=kv_bits)
 
     # merge the self token (its position == pos0: always causal-visible and
     # inside any window)
@@ -637,7 +684,8 @@ def _decode_attention(q, k_self, v_self, k_pool, v_pool, layer, block_tables,
 
 
 def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
-                 nb_max: int, NG: int, window, quantized: bool):
+                 nb_max: int, NG: int, window, quantized: bool,
+                 kv_bits: int = 8):
     """Prefill-past partials: one work item = G past blocks of one chunk
     atom, per-kv-head score/update loops over [R=tq*rep, G*bs] tiles."""
     if quantized:
@@ -652,7 +700,8 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
     i = pl.program_id(0)
     n_items = pl.num_programs(0)
     G, DEPTH = _PAST_G, _DMA_DEPTH
-    d = kpool.shape[-1] // K
+    # NOT from the pool lane width: the int4 pool packs two lanes per byte
+    d = q_ref.shape[-1]
     R = tq * rep
     a = i // NG
     g = jax.lax.rem(i, NG)
@@ -693,13 +742,22 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
             sc = sbuf[pl.ds(dst * G, G), 0]                   # [G, 2*bs]
             sck = sc[:, :bs].reshape(1, G * bs)
             scv = sc[:, bs:].reshape(1, G * bs)
+        if quantized and kv_bits == 4:
+            # unpack the whole [G*bs, K*d/2] tile once (per-head pairing),
+            # then per-head slabs slice the unpacked lanes
+            kfull = _unpack_int4_lanes(kbuf[dst].astype(jnp.float32), K, d)
+            vfull = _unpack_int4_lanes(vbuf[dst].astype(jnp.float32), K, d)
         for kk in range(K):
             qk = q_ref[0, kk]                    # [R, d]
-            kslab = kbuf[dst, :, kk * d:(kk + 1) * d]
-            vslab = vbuf[dst, :, kk * d:(kk + 1) * d]
-            if quantized:
-                kslab = kslab.astype(qk.dtype)
-                vslab = vslab.astype(qk.dtype)
+            if quantized and kv_bits == 4:
+                kslab = kfull[:, kk * d:(kk + 1) * d].astype(qk.dtype)
+                vslab = vfull[:, kk * d:(kk + 1) * d].astype(qk.dtype)
+            else:
+                kslab = kbuf[dst, :, kk * d:(kk + 1) * d]
+                vslab = vbuf[dst, :, kk * d:(kk + 1) * d]
+                if quantized:
+                    kslab = kslab.astype(qk.dtype)
+                    vslab = vslab.astype(qk.dtype)
             s = jax.lax.dot_general(
                 qk, kslab, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [R, G*bs]
@@ -794,12 +852,14 @@ def _self_kernel(len_ref, q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref, o_ref,
 
 def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
                        block_tables, atom_slot, atom_pos0, atom_len, tq, *,
-                       window, interpret, no_past=False, kv_scale=None):
+                       window, interpret, no_past=False, kv_scale=None,
+                       kv_bits: int = 8):
     """Chunk-atom attention = past work-list partials + seeded self flash.
-    Pools stacked lane-folded [L, nbp1, bs, K*d] (bf16, or int8 +
+    Pools stacked lane-folded [L, nbp1, bs, K*d] (bf16, or int8/int4 +
     ``kv_scale``)."""
     N, H, d = q.shape
-    bs, K = k_pool.shape[2], k_pool.shape[3] // d
+    lane_mul = 2 if (kv_scale is not None and kv_bits == 4) else 1
+    bs, K = k_pool.shape[2], k_pool.shape[3] * lane_mul // d
     rep = H // K
     A = N // tq
     R = tq * rep
@@ -818,15 +878,17 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
               .reshape(A, K, R, d))
         kernel = functools.partial(
             _past_kernel, scale=scale, bs=bs, tq=tq, K=K, rep=rep,
-            nb_max=nb_max, NG=NG, window=window, quantized=quantized)
+            nb_max=nb_max, NG=NG, window=window, quantized=quantized,
+            kv_bits=kv_bits)
         in_specs = [
             pl.BlockSpec((1, K, R, d), lambda i, *_: (i // NG, 0, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ]
+        kd_lanes = k_pool.shape[3]     # K*d, or K*d/2 for the int4 pool
         scratch = [
-            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), k_pool.dtype),
-            pltpu.VMEM((_DMA_DEPTH, G * bs, K * d), v_pool.dtype),
+            pltpu.VMEM((_DMA_DEPTH, G * bs, kd_lanes), k_pool.dtype),
+            pltpu.VMEM((_DMA_DEPTH, G * bs, kd_lanes), v_pool.dtype),
             pltpu.SemaphoreType.DMA((_DMA_DEPTH, 3 if quantized else 2, G)),
             pltpu.VMEM((K, R, 128), jnp.float32),
             pltpu.VMEM((K, R, 128), jnp.float32),
@@ -925,7 +987,8 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
                            interpret: Optional[bool] = None,
                            layer: Optional[jax.Array] = None,
                            no_past: bool = False,
-                           kv_scale: Optional[jax.Array] = None) -> jax.Array:
+                           kv_scale: Optional[jax.Array] = None,
+                           kv_bits: int = 8) -> jax.Array:
     """Attention over atoms of the packed token row.
 
     ``q``/``k_self``/``v_self``: [N, H|K, d] with N = n_atoms*tq; atom ``a``
@@ -965,6 +1028,9 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
     if not interpret and (d % 128 or bs % 8 or (tq > 1 and bs % 128)):
         kp = jax.lax.dynamic_index_in_dim(k_pool, layer, keepdims=False)
         vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
+        if kv_scale is not None and kv_bits == 4:
+            kp = _unpack_int4_lanes_xla(kp, K, d)
+            vp = _unpack_int4_lanes_xla(vp, K, d)
         kp = kp.reshape(*kp.shape[:2], K, d)
         vp = vp.reshape(*vp.shape[:2], K, d)
         if kv_scale is not None:                # dequant dense for fallback
@@ -981,11 +1047,12 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
         return _decode_attention(q, k_self, v_self, k_pool, v_pool, layer,
                                  block_tables, atom_slot, atom_pos0,
                                  atom_len, window=window, interpret=interpret,
-                                 kv_scale=kv_scale)
+                                 kv_scale=kv_scale, kv_bits=kv_bits)
     return _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
                               block_tables, atom_slot, atom_pos0, atom_len,
                               tq, window=window, interpret=interpret,
-                              no_past=no_past, kv_scale=kv_scale)
+                              no_past=no_past, kv_scale=kv_scale,
+                              kv_bits=kv_bits)
 
 
 def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
@@ -997,8 +1064,8 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                               window: Optional[int] = None,
                               layer: Optional[jax.Array] = None,
                               no_past: bool = False,
-                              kv_scale: Optional[jax.Array] = None
-                              ) -> jax.Array:
+                              kv_scale: Optional[jax.Array] = None,
+                              kv_bits: int = 8) -> jax.Array:
     """Tensor-parallel :func:`ragged_paged_attention`: heads embarrassingly
     parallel, q sharded on H, the atom KV and pools on K under shard_map
     (int8 per-token scales replicated)."""
@@ -1011,7 +1078,7 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                                       block_tables, atom_slot, atom_pos0,
                                       atom_len, tq, window=window,
                                       layer=layer, no_past=no_past,
-                                      kv_scale=kv_scale)
+                                      kv_scale=kv_scale, kv_bits=kv_bits)
     tp = mesh.shape[axis]
     H = q.shape[1]
     d = q.shape[2]
@@ -1038,7 +1105,8 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
         return ragged_paged_attention(q, ks, vs, kp, vp, bt, a_s, a_p, a_l,
                                       tq, window=window, layer=lay,
                                       no_past=no_past,
-                                      kv_scale=sc if sc.ndim == 4 else None)
+                                      kv_scale=sc if sc.ndim == 4 else None,
+                                      kv_bits=kv_bits)
 
     return jax.shard_map(
         shard_fn,
@@ -1094,24 +1162,39 @@ def packed_kv_append_quant(pool: jax.Array, scale_pool: jax.Array,
                            new_rows: jax.Array, block_tables: jax.Array,
                            tok_slot: jax.Array, tok_pos: jax.Array,
                            which: int,
-                           valid: Optional[jax.Array] = None):
-    """Quantize-and-append per-token KV rows into an int8 pool.
+                           valid: Optional[jax.Array] = None,
+                           bits: int = 8):
+    """Quantize-and-append per-token KV rows into an int8/int4 pool.
 
-    ``pool`` int8 [L, nb+1, bs, K*d]; ``scale_pool`` f32 [L, nb+1, 1,
-    2*bs]
+    ``pool`` int8 [L, nb+1, bs, K*d] (int8) or [L, nb+1, bs, K*d/2]
+    (int4: lane j paired with j + K*d/2 per byte, see
+    :func:`_unpack_int4_lanes`); ``scale_pool`` f32 [L, nb+1, 1, 2*bs]
     holding per-token dequant scales (k rows in lanes [0, bs), v in
     [bs, 2bs) — ``which`` 0/1 selects the half); ``new_rows`` float
-    [L, N, K, d] or [L, N, K*d]. Each row is quantized ONCE with its own
-    amax/127 scale and never requantized — per-token granularity is what
-    makes incremental block filling exact. Under tensor parallelism the
-    amax over the (sharded) head dim is an automatic GSPMD all-reduce, so
-    every shard records the same scale. Returns (pool, scale_pool)."""
-    L, nbp1, bs, KD = pool.shape
+    [L, N, K, d] or [L, N, K*d]. ``bits=4`` needs the 4-D rows form (the
+    per-head lane pairing needs K and d). Each row is quantized ONCE with
+    its own amax/qmax scale and never requantized — per-token granularity
+    is what makes incremental block filling exact. Under tensor
+    parallelism the amax over the (sharded) head dim is an automatic GSPMD
+    all-reduce, so every shard records the same scale.
+    Returns (pool, scale_pool)."""
+    L, nbp1, bs, _lanes = pool.shape
     N = new_rows.shape[1]
+    KD = (new_rows.shape[-1] * new_rows.shape[-2]
+          if new_rows.ndim == 4 else new_rows.shape[-1])
     rows = new_rows.reshape(L, N, KD).astype(jnp.float32)
-    sc = jnp.maximum(jnp.max(jnp.abs(rows), axis=-1) / 127.0, 1e-8)  # [L, N]
-    qrows = jnp.clip(jnp.round(rows / sc[..., None]), -127, 127) \
+    qmax = 7.0 if bits == 4 else 127.0
+    sc = jnp.maximum(jnp.max(jnp.abs(rows), axis=-1) / qmax, 1e-8)  # [L, N]
+    qrows = jnp.clip(jnp.round(rows / sc[..., None]), -qmax, qmax) \
         .astype(jnp.int8)
+    if bits == 4:
+        # global lane pairing: byte j = (feature j, feature j + KD/2)
+        lo = qrows[..., :KD // 2]
+        hi = qrows[..., KD // 2:]
+        qrows = (((lo.astype(jnp.int32) & 0xF)
+                  | ((hi.astype(jnp.int32) & 0xF) << 4))
+                 .astype(jnp.int8))
+    KD_pool = _lanes
     bt_rows = block_tables[tok_slot]
     logical = jnp.clip(tok_pos // bs, 0, bt_rows.shape[1] - 1)
     phys = jnp.take_along_axis(bt_rows, logical[:, None], axis=1)[:, 0]
@@ -1122,8 +1205,8 @@ def packed_kv_append_quant(pool: jax.Array, scale_pool: jax.Array,
     if valid is not None:
         idx = jnp.where(valid[None, :], idx, L * nbp1 * bs)
         sidx = jnp.where(valid[None, :], sidx, L * nbp1 * 2 * bs)
-    flat = pool.reshape(L * nbp1 * bs, KD)
-    flat = flat.at[idx.reshape(-1)].set(qrows.reshape(L * N, KD),
+    flat = pool.reshape(L * nbp1 * bs, KD_pool)
+    flat = flat.at[idx.reshape(-1)].set(qrows.reshape(L * N, KD_pool),
                                         mode="drop", unique_indices=True)
     sflat = scale_pool.reshape(L * nbp1 * 2 * bs)
     sflat = sflat.at[sidx.reshape(-1)].set(sc.reshape(-1), mode="drop",

@@ -407,6 +407,61 @@ def test_int8_kv_cache_matches_bf16(tiny_lm):
     assert all(len(out[u]) == 4 for u in (1, 2))
 
 
+def test_int4_kv_cache_tracks_bf16(tiny_lm):
+    """int4 paged pool (per-head lane-paired nibbles + per-token scales):
+    must track the bf16 engine through prefill/continuation/fused decode
+    within 4-bit tolerance."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 256, n) for n in (21, 9)]
+    cont = rng.integers(0, 256, 5)
+    outs = {}
+    engs = {}
+    for mode in ("bf16", "int4"):
+        eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                                max_seq_len=64, block_size=8, kv_dtype=mode)
+        outs[mode] = [eng.put([1, 2], prompts)]
+        outs[mode].append(eng.put([1, 2], [np.array([3]), np.array([4])]))
+        outs[mode].append(eng.put([1, 2], [cont, np.array([7])]))
+        engs[mode] = eng
+    assert engs["int4"].cache["k"].shape[-1] \
+        == model.cfg.num_kv_heads * model.cfg.head_dim // 2
+    for step_a, step_b in zip(outs["bf16"], outs["int4"]):
+        for u in (1, 2):
+            a = np.asarray(step_a[u], np.float32)
+            b = np.asarray(step_b[u], np.float32)
+            # 4-bit KV: ~16x coarser than int8 — loose but bounded
+            assert np.abs(a - b).max() < 0.6 * max(np.abs(a).max(), 1.0), \
+                (u, np.abs(a - b).max())
+    out = engs["int4"].decode_batch([1, 2], [1, 2], steps=4)
+    assert all(len(out[u]) == 4 for u in (1, 2))
+
+
+def test_int4_append_roundtrip():
+    """bits=4 packed_kv_append_quant: unpacking the pool row reproduces the
+    source row within its per-token scale (per-head lane pairing)."""
+    from deepspeed_tpu.ops.paged_attention import (_unpack_int4_lanes_xla,
+                                                   packed_kv_append_quant)
+
+    L, N, K, d, bs, nb = 2, 6, 2, 16, 8, 4
+    rng = np.random.default_rng(5)
+    rows = jnp_f(rng.normal(size=(L, N, K, d)))
+    pool = jnp_np(np.zeros((L, nb + 1, bs, K * d // 2), np.int8))
+    scales = jnp_f(np.zeros((L, nb + 1, 1, 2 * bs)))
+    bt = jnp_np(np.arange(8, dtype=np.int32).reshape(2, 4))
+    tok_slot = jnp_np(np.array([0] * N, np.int32))
+    tok_pos = jnp_np(np.arange(N, dtype=np.int32))
+    npool, nsc = packed_kv_append_quant(pool, scales, rows, bt, tok_slot,
+                                        tok_pos, 0, bits=4)
+    got = np.asarray(_unpack_int4_lanes_xla(npool[:, 0, :N], K, d))
+    sc = np.asarray(nsc[:, 0, 0, :N])                       # [L, N]
+    recon = got * sc[..., None]
+    ref = np.asarray(rows, np.float32).reshape(L, N, K * d)
+    err = np.abs(recon - ref).max()
+    tol = (np.abs(ref).max() / 7.0) * 0.51 + 1e-6
+    assert err <= tol, (err, tol)
+
+
 def test_decode_batch_sampling(tiny_lm):
     """Sampling inside the fused loop (reference FastGen serves sampled
     tokens): deterministic per seed, greedy at temperature 0, and the
