@@ -1,8 +1,12 @@
-"""Profile one fused decode_batch @occ32 int8kv+int8w: where does the step go?"""
+"""Profile one fused decode_batch: where does the step go?
+
+Usage: python _prof_decode.py [occ] [weight_dtype] [kv_dtype] [steps]
+Prints per-op device durations (XLA Ops track) grouped by op name.
+"""
 import glob
 import gzip
 import json
-import os
+import sys
 
 import numpy as np
 import jax
@@ -10,33 +14,44 @@ import jax
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.models import TransformerConfig, TransformerLM
 
+occ = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+wd = sys.argv[2] if len(sys.argv) > 2 else "int8"
+kvd = sys.argv[3] if len(sys.argv) > 3 else "int8"
+steps = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+
 cfg = TransformerConfig(vocab_size=32000, hidden_size=1536, num_layers=16,
                         num_heads=12, num_kv_heads=6, max_seq_len=4096)
 model = TransformerLM(cfg)
 params = jax.jit(model.init)(jax.random.key(0))
-eng = InferenceEngineV2(model, params=params, max_sequences=32,
+kw = {} if wd == "bf16" else {"weight_dtype": wd}
+eng = InferenceEngineV2(model, params=params, max_sequences=occ,
                         max_seq_len=648, block_size=128,
-                        kv_dtype="int8", weight_dtype="int8")
+                        kv_dtype=kvd, **kw)
 rng = np.random.default_rng(0)
-uids = list(range(32))
-for i in range(0, 32, 16):
+uids = list(range(occ))
+for i in range(0, occ, 16):
     grp = uids[i:i + 16]
     eng.put(grp, [rng.integers(0, 32000, 512) for _ in grp])
-toks = [0] * 32
-eng.decode_batch(uids, toks, steps=16)      # warmup/compile
+toks = [0] * occ
+eng.decode_batch(uids, toks, steps=steps)      # warmup/compile
 with jax.profiler.trace("/tmp/decode_trace"):
-    eng.decode_batch(uids, toks, steps=16)
+    eng.decode_batch(uids, toks, steps=steps)
 
-# parse: sum device durations by op name prefix
 path = sorted(glob.glob("/tmp/decode_trace/**/*.trace.json.gz",
                         recursive=True))[-1]
 ev = json.loads(gzip.open(path).read())["traceEvents"]
-tot = {}
+tids = {}
 for e in ev:
-    if e.get("ph") == "X" and "dur" in e:
-        name = e.get("name", "")
-        pid_name = e.get("pid")
-        key = name.split(".")[0].split("(")[0][:46]
+    if e.get("ph") == "M" and e.get("name") == "thread_name":
+        tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
+tot, cnt = {}, {}
+for e in ev:
+    if (e.get("ph") == "X" and "dur" in e
+            and tids.get((e.get("pid"), e.get("tid"))) == "XLA Ops"):
+        key = e["name"][:60]
         tot[key] = tot.get(key, 0) + e["dur"]
-for k, v in sorted(tot.items(), key=lambda kv: -kv[1])[:24]:
-    print(f"{v/1e3:9.2f} ms  {k}")
+        cnt[key] = cnt.get(key, 0) + 1
+print(f"== occ={occ} w={wd} kv={kvd} steps={steps} "
+      f"(per-step us = total/steps)")
+for k, v in sorted(tot.items(), key=lambda kv: -kv[1])[:20]:
+    print(f"{v/1e3:9.2f} ms {cnt[k]:5d}x  {v/steps:8.1f} us/step  {k}")

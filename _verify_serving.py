@@ -1,0 +1,85 @@
+"""User-style verification drive: public serving surface after the decode
+kernel rework (gated worklist DMAs, int8 MXU score dot, int4 i32-shift
+dequant, engine timing split). Run on real TPU (default) or the 8-device
+CPU mesh (DSTPU_VERIFY_CPU=1)."""
+import os
+
+if os.environ.get("DSTPU_VERIFY_CPU") == "1":
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+on_tpu = jax.devices()[0].platform != "cpu"
+print(f"devices: {jax.devices()}")
+
+# 1. public v1 surface: init_inference with int8 weights + generate
+cfg = TransformerConfig(vocab_size=1024, hidden_size=256, num_layers=2,
+                        num_heads=4, num_kv_heads=2, max_seq_len=256,
+                        arch="llama")
+model = TransformerLM(cfg)
+eng1 = deepspeed_tpu.init_inference(model, dtype="int8")
+prompt = np.array([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+toks = eng1.generate(prompt, max_new_tokens=8)
+print("v1 int8 generate:", np.asarray(toks)[0, -8:].tolist())
+
+# 2. v2 engine: every kv/weight dtype combo decodes coherently vs bf16
+rng = np.random.default_rng(0)
+params = jax.jit(model.init)(jax.random.key(0))
+prompts = [rng.integers(0, cfg.vocab_size, 48) for _ in range(4)]
+ref_logits = None
+for wd, kvd in (("bf16", "bf16"), ("bf16", "int8"), ("int8", "int8"),
+                ("int4", "int8"), ("bf16", "int4")):
+    eng = InferenceEngineV2(model, params=params, max_sequences=8,
+                            max_seq_len=256, block_size=128, kv_dtype=kvd,
+                            weight_dtype=wd)
+    r = eng.put([0, 1, 2, 3], prompts)
+    out = eng.decode_batch([0, 1, 2, 3], [int(np.argmax(r[u]))
+                                          for u in range(4)], steps=12)
+    lg = np.stack([np.asarray(r[u], np.float32) for u in range(4)])
+    if ref_logits is None:
+        ref_logits = lg
+        ref_toks = {u: out[u].copy() for u in out}
+    else:
+        rel = np.abs(lg - ref_logits).max() / np.abs(ref_logits).max()
+        agree = np.mean([np.mean(out[u] == ref_toks[u]) for u in out])
+        print(f"w={wd:4s} kv={kvd:4s}: prefill_rel_err={rel:.3f} "
+              f"decode_token_agreement={agree:.2f}")
+        # int4 on a random-init model carries ~16x int8's quantization
+        # error (no outlier structure to exploit); token agreement is not
+        # asserted at all — bf16 argmax ties flip on random-init logits
+        assert rel < (0.8 if "int4" in (wd, kvd) else 0.25), \
+            f"{wd}/{kvd} prefill diverged"
+    # timing split exists and host cost is sane
+    eng.put([0, 1, 2, 3], [np.array([5])] * 4)
+    t = eng.timing
+    assert set(t) == {"host_ms", "dispatch_ms", "fetch_ms"}, t
+    assert t["host_ms"] < 50, t
+    eng.flush([0, 1, 2, 3])
+    del eng
+print("timing split (last):", {k: round(v, 2) for k, v in t.items()})
+
+# 3. bad-config probes still fail loudly
+try:
+    InferenceEngineV2(model, params=params, max_sequences=2,
+                      max_seq_len=256, kv_dtype="fp7")
+    raise SystemExit("kv_dtype probe failed to raise")
+except ValueError as e:
+    print("kv_dtype probe ok:", e)
+try:
+    InferenceEngineV2(model, params=params, max_sequences=2,
+                      max_seq_len=256, weight_dtype="int2")
+    raise SystemExit("weight_dtype probe failed to raise")
+except ValueError as e:
+    print("weight_dtype probe ok:", e)
+
+print("VERIFY OK")

@@ -17,6 +17,7 @@ prefetch. A ``paged=False`` escape hatch keeps the dense per-slot cache
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -94,7 +95,13 @@ class InferenceEngineV2:
             # table (it reads B rows/step, not the full [V, D]).
             params = self._quantize_weights(
                 params, bits=4 if weight_dtype == "int4" else 8)
+            # the quantizer restructures the served tree (fused wqkv/
+            # w_gateup, QuantizedWeight leaves, popped lm_head) — the spec
+            # tree computed above no longer matches and must not be
+            # re-applied to self.params
+            self.param_sharding = None
         self.params = params
+        self.timing: Dict[str, float] = {}
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
         if kv_dtype not in ("bf16", "int8", "int4"):
@@ -178,12 +185,33 @@ class InferenceEngineV2:
                     self.cache["pos"] = self.cache["pos"].at[seq.slot].set(0)
             self.state.flush(uid)
 
+    # incremental block-table cache: rows refresh only when a sequence's
+    # block count changed or its slot was reused (SequenceManager bumps
+    # slot_generation on release) — a full rebuild per put() was
+    # O(max_seqs x nb_max) of host work on the put critical path
+    _bt_cache = None
+    _bt_key = None
+
     def _block_tables(self) -> np.ndarray:
-        """[max_sequences, nb_max] physical block ids; unused → scratch block."""
-        bt = np.full((self.state.max_sequences, self.nb_max), self.num_blocks,
-                     np.int32)
+        """[max_sequences, nb_max] physical block ids. Invariant: rows of
+        SCHEDULED slots are correct; a flushed slot's row keeps its stale
+        ids until the slot is reused (only scheduled slots' rows are ever
+        read — atoms/decode items index by live slot). Unused tail entries
+        of a live row point at the scratch block."""
+        if self._bt_cache is None:
+            self._bt_cache = np.full(
+                (self.state.max_sequences, self.nb_max), self.num_blocks,
+                np.int32)
+            self._bt_key = {}
+        bt = self._bt_cache
+        gen = self.state.slot_generation
         for seq in self.state.sequences.values():
-            bt[seq.slot, :len(seq.blocks)] = seq.blocks
+            key = (gen[seq.slot], len(seq.blocks))
+            if self._bt_key.get(seq.slot) != key:
+                n = key[1]
+                bt[seq.slot, :n] = seq.blocks
+                bt[seq.slot, n:] = self.num_blocks
+                self._bt_key[seq.slot] = key
         return bt
 
     def _multi_decode(self, params, cache, bt, slots, pos0, tok0, steps: int,
@@ -337,6 +365,9 @@ class InferenceEngineV2:
     def _prefill_whole(self, batch_uids: Sequence[int], chunks
                        ) -> Dict[int, np.ndarray]:
         """Fresh whole prompts: flash-prefill every prompt in one step."""
+        t_entry = time.perf_counter()     # per-invocation host clock: the
+        # grouped recursion below runs earlier groups' device steps to
+        # completion, so timing must not be measured from put() entry
         if not self.state.can_schedule_batch(batch_uids,
                                              [len(c) for c in chunks]):
             raise RuntimeError(
@@ -364,12 +395,19 @@ class InferenceEngineV2:
             ids[i, :len(c)] = c
             lengths[i] = len(c)
             slots[i] = d.slot
+        t_host = time.perf_counter()
         with jax.sharding.set_mesh(self.mesh):
             logits, self.cache = self._prefill_step(
                 self.params, jnp.asarray(ids), jnp.asarray(lengths),
                 self.cache, jnp.asarray(self._block_tables()),
                 jnp.asarray(slots))
+            t_disp = time.perf_counter()
             out = np.asarray(logits)
+        self.timing = {
+            "host_ms": (t_host - t_entry) * 1e3,
+            "dispatch_ms": (t_disp - t_host) * 1e3,
+            "fetch_ms": (time.perf_counter() - t_disp) * 1e3,
+        }
         results: Dict[int, np.ndarray] = {}
         for i, (d, c) in enumerate(zip(descs, chunks)):
             results[d.uid] = out[i]
@@ -385,6 +423,8 @@ class InferenceEngineV2:
         tokens, or anything between — per-slot cache positions make the batch
         ragged in effect while dense in shape."""
         assert len(batch_uids) == len(batch_tokens)
+        t_put = time.perf_counter()
+        self.timing = {}        # never report a previous put's numbers
         chunks = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
         if self.packed and chunks and all(len(c) > 1 for c in chunks) \
                 and max(len(c) for c in chunks) <= self.module.PREFILL_MAX \
@@ -408,6 +448,10 @@ class InferenceEngineV2:
                        if len(c) > cap]
                 self.put([u for u, _ in sel], [c for _, c in sel])
                 chunks = [c[cap:] if len(c) > cap else c for c in chunks]
+                # rebase the host clock: the sub-puts above ran device
+                # steps to completion — without this, the final step's
+                # host_ms would absorb their device+fetch time
+                t_put = time.perf_counter()
         if not self.state.can_schedule_batch(batch_uids,
                                              [len(c) for c in chunks]):
             raise RuntimeError(
@@ -463,13 +507,26 @@ class InferenceEngineV2:
             # when every chunk atom starts at position 0 (fresh prefill) the
             # past kernel is statically skipped — the common first-put case
             no_past = all(d.seen_tokens == 0 for _, d, c in big)
+            t_host = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step_packed(
                     self.params, jnp.asarray(tok_ids), self.cache,
                     jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
                     jnp.asarray(tok_pos), jnp.asarray(valid),
                     jnp.asarray(gather_idx), dr, tile, no_past)
+                t_disp = time.perf_counter()
                 out = np.asarray(logits)
+            t_fetch = time.perf_counter()
+            # host scheduling vs dispatch vs device+transfer accounting:
+            # host_ms is pure python/numpy batch building, dispatch_ms is
+            # the async jit call (argument transfer + enqueue), fetch_ms
+            # blocks on the device step + the logits D2H (on a tunneled
+            # runtime it also carries the transport RTT)
+            self.timing = {
+                "host_ms": (t_host - t_put) * 1e3,
+                "dispatch_ms": (t_disp - t_host) * 1e3,
+                "fetch_ms": (t_fetch - t_disp) * 1e3,
+            }
             results: Dict[int, np.ndarray] = {}
             for i, (d, c) in enumerate(zip(descs, chunks)):
                 results[d.uid] = out[i]

@@ -37,11 +37,32 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
 
     def q_stacked(w):  # [L, Din, F] → QuantizedWeight of stacked leaves
         if w.ndim != 3 or w.shape[1] % 128 or w.shape[2] % 128:
-            return w  # MoE expert stacks / odd geometries stay dense
+            return w  # odd geometries stay dense
         ps = [q2(w[i]) for i in range(w.shape[0])]
         return QuantizedWeight(jnp.stack([p for p, _ in ps]),
                                jnp.stack([s for _, s in ps]),
                                bits, w.shape[1])
+
+    def q_experts(w):  # [L, E, Din, F] → (packed int8, scales) leaf pair
+        """MoE expert stacks quantize to PLAIN int8 arrays (name+'_q' /
+        name+'_s' leaves) rather than QuantizedWeight: the grouped
+        ``ragged_dot`` path dequants inside the GEMM operand read (see
+        moe/sharded_moe.py _expert_weight), and plain leaves ride the layer
+        scan / ep shard_map specs unchanged. int8 regardless of the engine
+        ``bits`` — expert reads dominate MoE serving HBM, and the XLA-side
+        dequant has no int4 nibble-unpack it could fold for free."""
+        if w.ndim != 4 or w.shape[2] % 128 or w.shape[3] % 128:
+            return None
+        from deepspeed_tpu.ops.quant_matmul import quantize_matmul_weight
+
+        def q1(w2):
+            p, s = quantize_matmul_weight(w2.astype(jnp.float32), bits=8)
+            return p, s.astype(cdt)
+
+        per_layer = jax.jit(jax.vmap(q1))       # over experts of one layer
+        ps = [per_layer(w[i]) for i in range(w.shape[0])]
+        return (jnp.stack([p for p, _ in ps]),
+                jnp.stack([s for _, s in ps]))
 
     with jax.sharding.set_mesh(mesh):
         layers = dict(params["layers"])
@@ -67,7 +88,14 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
         for grp in ("attn", "mlp"):
             sub = dict(layers[grp])
             for name in QUANT_LEAVES:
-                if name in sub:
+                if name not in sub:
+                    continue
+                if grp == "mlp" and sub[name].ndim == 4:
+                    r = q_experts(sub[name])    # MoE expert stack
+                    if r is not None:
+                        sub[name + "_q"], sub[name + "_s"] = r
+                        del sub[name]
+                else:
                     sub[name] = jax.jit(q_stacked)(sub[name])
             layers[grp] = sub
         params = {**params, "layers": layers}

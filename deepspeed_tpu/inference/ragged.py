@@ -64,6 +64,10 @@ class SequenceManager:
             block_size)
         self.sequences: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(max_sequences))
+        # bumped whenever a slot is released: lets engines cache per-slot
+        # derived state (block-table rows) and detect slot reuse even when
+        # the new occupant happens to have the same block count
+        self.slot_generation = [0] * max_sequences
 
     def get_or_create(self, uid: int) -> SequenceDescriptor:
         if uid in self.sequences:
@@ -124,3 +128,4 @@ class SequenceManager:
         if seq is not None:
             self.allocator.free(seq.blocks)
             self._free_slots.append(seq.slot)
+            self.slot_generation[seq.slot] += 1

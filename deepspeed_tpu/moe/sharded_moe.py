@@ -112,6 +112,27 @@ def top1_gating(logits: jax.Array, **kw):
     return topk_gating(logits, k=1, **kw)
 
 
+def _expert_weight(w: Dict[str, jax.Array], name: str, dt) -> jax.Array:
+    """Expert stack [E, D, F] in the compute dtype. Serving engines may
+    replace the dense stack with int8 leaves (``name+'_q'`` packed values +
+    ``name+'_s'`` per-group scales, see ``inference/quant.py``) — the
+    dequant here is elementwise, so XLA folds it into the grouped GEMM's
+    operand read and expert weights stream from HBM at 1 byte/element
+    (reference ``inference/v2/kernels/cutlass_ops/moe_gemm`` W8A16 parity:
+    expert stacks are exactly where serving HBM pressure concentrates)."""
+    if name in w:
+        return w[name].astype(dt)
+    q, s = w[name + "_q"], w[name + "_s"]
+    E, D, F = q.shape
+    G = s.shape[1]
+    return (q.astype(dt).reshape(E, G, D // G, F)
+            * s.astype(dt).reshape(E, G, 1, F)).reshape(E, D, F)
+
+
+def _has_gate(w: Dict[str, jax.Array]) -> bool:
+    return "w_gate" in w or "w_gate_q" in w
+
+
 def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
                   valid: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array]:
@@ -134,13 +155,18 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     dt = h.dtype
     xe = jnp.einsum("sec,sd->ecd", dispatch.astype(dt), x)       # [E, C, D]
     xe = constrain(xe, P("ep", None, None))
-    if "w_gate" in w:
-        act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w["w_gate"]))
-        act = act * jnp.einsum("ecd,edf->ecf", xe, w["w_up"])
+    if _has_gate(w):
+        act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe,
+                                     _expert_weight(w, "w_gate", dt)))
+        act = act * jnp.einsum("ecd,edf->ecf", xe,
+                               _expert_weight(w, "w_up", dt))
     else:
-        act = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w["w_up"]), approximate=True)
+        act = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe,
+                                     _expert_weight(w, "w_up", dt)),
+                          approximate=True)
     act = constrain(act, P("ep", None, "tp"))
-    ye = jnp.einsum("ecf,efd->ecd", act, w["w_down"])            # [E, C, D]
+    ye = jnp.einsum("ecf,efd->ecd", act,
+                    _expert_weight(w, "w_down", dt))             # [E, C, D]
     ye = constrain(ye, P("ep", None, None))
     y = jnp.einsum("sec,ecd->sd", combine.astype(dt), ye)
     return y.reshape(B, T, D), aux
@@ -149,15 +175,20 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
 def _grouped_ffn(xs: jax.Array, group_sizes: jax.Array, w: Dict[str, jax.Array],
                  dt) -> jax.Array:
     """Expert-grouped FFN over tokens sorted by expert: the
-    ``lax.ragged_dot`` chain XLA lowers to a grouped (MegaBlocks-style) GEMM."""
-    if "w_gate" in w:
-        act = jax.nn.silu(jax.lax.ragged_dot(xs, w["w_gate"].astype(dt),
-                                             group_sizes))
-        act = act * jax.lax.ragged_dot(xs, w["w_up"].astype(dt), group_sizes)
+    ``lax.ragged_dot`` chain XLA lowers to a grouped (MegaBlocks-style) GEMM
+    (int8 serving stacks dequant inside the operand read, see
+    :func:`_expert_weight`)."""
+    if _has_gate(w):
+        act = jax.nn.silu(jax.lax.ragged_dot(
+            xs, _expert_weight(w, "w_gate", dt), group_sizes))
+        act = act * jax.lax.ragged_dot(xs, _expert_weight(w, "w_up", dt),
+                                       group_sizes)
     else:
-        act = jax.nn.gelu(jax.lax.ragged_dot(xs, w["w_up"].astype(dt),
-                                             group_sizes), approximate=True)
-    return jax.lax.ragged_dot(act, w["w_down"].astype(dt), group_sizes)
+        act = jax.nn.gelu(jax.lax.ragged_dot(
+            xs, _expert_weight(w, "w_up", dt), group_sizes),
+            approximate=True)
+    return jax.lax.ragged_dot(act, _expert_weight(w, "w_down", dt),
+                              group_sizes)
 
 
 def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,

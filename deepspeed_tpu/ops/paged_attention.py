@@ -279,20 +279,28 @@ def paged_attention_tp(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
 # (the atom-width cap lives on TransformerLM.MAX_ATOM — the engine chunking
 # and the VMEM-bounded kernel tile share that single constant)
 
-_DECODE_G = 4       # KV blocks per decode work item (one DMA pair per item)
+_DECODE_G = 8       # KV blocks per decode work item (one DMA pair per item)
 _PAST_G = 2         # KV blocks per prefill-past work item (bigger per-block
                     # compute; smaller groups keep VMEM under the 16MB cap)
-_DMA_DEPTH = 2      # work-item fetches kept in flight across the work list
+_DMA_DEPTH = 3      # work-item fetches kept in flight across the work list
 
 
-def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
-                      bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem,
+def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, nblk_ref, lo_ref,
+                      ng_ref, bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem,
                       spool=None, sbuf=None):
     """Shared work-list DMA machinery: item j = G consecutive logical KV
     blocks of atom j//NG, streamed from the STACKED pool layer li. With an
     int8 pool, ``spool`` [L, nbp1, 1, 2*bs] carries the per-token
     dequant scales (k in lanes [0,bs), v in [bs,2bs)) — one extra f32 row
-    copy per block."""
+    copy per block.
+
+    Every copy is paired with a per-block validity predicate (from
+    ``nblk_ref``, computed host-side by the same ``_past_ranges`` call that
+    produced ``ng_ref`` — a single source of truth) and the call sites gate
+    start()/wait() on it: an atom's tail group only streams its REAL
+    blocks. Unguarded, the clipped tail re-read the last block G-ish times
+    — at 512-token contexts that was ~1.8x the useful KV bytes, and the
+    decode kernel is pure KV bandwidth."""
 
     def item_dmas(j, dst):
         jc = jnp.clip(j, 0, n_items - 1)
@@ -300,23 +308,25 @@ def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
         gj = jax.lax.rem(jc, NG)
         slot = slot_ref[aj]
         li = li_ref[0]
+        nblk = nblk_ref[aj]
         copies = []
         for gg in range(G):
+            ok = gj * G + gg < nblk
             lb = jnp.clip(lo_ref[aj] + gj * G + gg, 0, nb_max - 1)
             bid = bt_ref[slot, lb]
-            copies.append(pltpu.make_async_copy(
+            copies.append((pltpu.make_async_copy(
                 kpool.at[li, bid], kbuf.at[dst, pl.ds(gg * bs, bs)],
-                dsem.at[dst, 0, gg]))
-            copies.append(pltpu.make_async_copy(
+                dsem.at[dst, 0, gg]), ok))
+            copies.append((pltpu.make_async_copy(
                 vpool.at[li, bid], vbuf.at[dst, pl.ds(gg * bs, bs)],
-                dsem.at[dst, 1, gg]))
+                dsem.at[dst, 1, gg]), ok))
             if spool is not None:
                 # sbuf rows are [1, 2bs] leading-dim slices (Mosaic requires
                 # minor-dim slices be tile-aligned; a [G, 2bs] row pick
                 # along dim 1 is not)
-                copies.append(pltpu.make_async_copy(
+                copies.append((pltpu.make_async_copy(
                     spool.at[li, bid], sbuf.at[dst * G + gg],
-                    dsem.at[dst, 2, gg]))
+                    dsem.at[dst, 2, gg]), ok))
         return copies
 
     def item_active(j):
@@ -326,10 +336,20 @@ def _worklist_helpers(n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref,
     return item_dmas, item_active
 
 
+def _gated_dmas(copies, op):
+    """start()/wait() each (copy, valid) pair under its own predicate."""
+    for c, ok in copies:
+        @pl.when(ok)
+        def _go(c=c):
+            getattr(c, op)()
+
+
 def _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window):
-    """(lo block, group count >= 1) of each atom's visible past range.
-    ``row_pos`` (>= pos0) is the query row's global position — it trails the
-    sliding window; ``pos0`` is the pool frontier (tokens < pos0 cached)."""
+    """(pos0, lo block, valid block count, group count >= 1) of each atom's
+    visible past range. ``row_pos`` (>= pos0) is the query row's global
+    position — it trails the sliding window; ``pos0`` is the pool frontier
+    (tokens < pos0 cached). ``nblk`` feeds the kernels' per-copy DMA gate —
+    computed HERE, once, so the gate can never disagree with ``ng``."""
     pos0 = atom_pos0.astype(jnp.int32)
     if window is not None:
         lo = jnp.maximum((row_pos.astype(jnp.int32) - (window - 1)) // bs, 0)
@@ -339,24 +359,36 @@ def _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window):
         pos0 > 0,
         jnp.maximum(jnp.minimum((pos0 - 1) // bs, nb_max - 1) - lo + 1, 0), 0)
     ng = jnp.maximum(-(-nblk // G), 1).astype(jnp.int32)
-    return pos0, lo.astype(jnp.int32), ng
+    return pos0, lo.astype(jnp.int32), nblk.astype(jnp.int32), ng
 
 
-def _unpack_int4_lanes(packed_f32, K: int, d: int):
-    """[R, K*d/2] float-valued packed bytes → [R, K*d] int4 values as f32.
+def _quantize_q_rows(q):
+    """Per-row (last-axis) int8 fake-quant of a query tensor. Returns
+    (q_int8, scale) — the ONE definition of the int8-KV decode path's q-hat
+    semantics, shared by the kernel wrapper and its XLA twin so they stay
+    bit-identical."""
+    qf = q.astype(jnp.float32)
+    qs = jnp.maximum(jnp.max(jnp.abs(qf), axis=-1, keepdims=True) / 127.0,
+                     1e-12)
+    qi = jnp.clip(jnp.round(qf / qs), -127, 127)
+    return qi.astype(jnp.int8), qs
+
+
+def _unpack_int4_lanes(packed_i8, K: int, d: int):
+    """[R, K*d/2] packed int8 bytes → [R, K*d] int4 values as bf16.
 
     Lane pairing is GLOBAL — byte lane j holds features j (low nibble) and
     j + K*d/2 (high) — so the unpack is one 128-aligned lane concat
     (per-head pairing would need d/2-lane slices, which Mosaic will not
     lower; the cost is that an int4 pool cannot be lane-sharded over tp —
-    the engine guards that combination). Float arithmetic because Mosaic
-    does not legalize int8 vector shifts (see ops/quant_matmul.py)."""
+    the engine guards that combination). i32 shifts sign-extend the nibbles
+    for free (Mosaic legalizes i32 but not i8 vector shifts); this replaced
+    a float floor/divide unpack whose VPU cost outweighed the byte saving
+    (see ops/quant_matmul.py _qmm_body for the same rework)."""
     del K, d
-    u = packed_f32 + 256.0 * (packed_f32 < 0)
-    hi = jnp.floor(u / 16.0)
-    lo = u - 16.0 * hi
-    lo = lo - 16.0 * (lo >= 8)
-    hi = hi - 16.0 * (hi >= 8)
+    b32 = packed_i8.astype(jnp.int32)
+    lo = ((b32 << 28) >> 28).astype(jnp.bfloat16)
+    hi = (b32 >> 4).astype(jnp.bfloat16)
     return jnp.concatenate([lo, hi], axis=-1)
 
 
@@ -364,15 +396,24 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
                    nb_max: int, NG: int, window, quantized: bool,
                    kv_bits: int = 8):
     """One work item = G consecutive past-KV blocks of one decode atom."""
-    if quantized:
-        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref, bt_ref,
-         q_ref, kpool, vpool, spool, acc_ref, m_ref, l_ref,
+    if quantized and kv_bits == 8:
+        # int8 pool + int8 q: the score dot runs on the int8 MXU and the K
+        # tile is never converted — the convert of the whole [G*bs, K*d]
+        # tile was ~30% of the int8 decode step (the kernel sat at ~430
+        # GB/s effective vs the bf16 kernel's ~590)
+        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, nblk_ref, ng_ref,
+         bt_ref, q_ref, qs_ref, kpool, vpool, spool, acc_ref, m_ref, l_ref,
          kbuf, vbuf, sbuf, dsem, m_scr, l_scr, acc_scr) = refs
+    elif quantized:
+        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, nblk_ref, ng_ref,
+         bt_ref, q_ref, kpool, vpool, spool, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, sbuf, dsem, m_scr, l_scr, acc_scr) = refs
+        qs_ref = None
     else:
-        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, ng_ref, bt_ref,
-         q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
+        (li_ref, slot_ref, pos0_ref, row_ref, lo_ref, nblk_ref, ng_ref,
+         bt_ref, q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
          kbuf, vbuf, dsem, m_scr, l_scr, acc_scr) = refs
-        spool = sbuf = None
+        spool = sbuf = qs_ref = None
     i = pl.program_id(0)
     n_items = pl.num_programs(0)
     G, DEPTH = _DECODE_G, _DMA_DEPTH
@@ -381,16 +422,21 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
     a = i // NG
     g = jax.lax.rem(i, NG)
     item_dmas, item_active = _worklist_helpers(
-        n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
-        kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
+        n_items, NG, G, bs, nb_max, slot_ref, nblk_ref, lo_ref, ng_ref,
+        bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
 
     @pl.when(i == 0)
     def _warmup():
+        # gated DMAs leave tail slots untouched, so stale VMEM must start
+        # finite: p~0 x NaN garbage would poison the pv@vb contraction
+        kbuf[:] = jnp.zeros_like(kbuf)
+        vbuf[:] = jnp.zeros_like(vbuf)
+        if sbuf is not None:
+            sbuf[:] = jnp.zeros_like(sbuf)
         for joff in range(DEPTH):
             @pl.when(item_active(joff))
             def _issue(_j=joff):
-                for c in item_dmas(_j, _j % DEPTH):
-                    c.start()
+                _gated_dmas(item_dmas(_j, _j % DEPTH), "start")
 
     @pl.when(g == 0)
     def _init():
@@ -403,28 +449,31 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
     @pl.when(active)
     def _compute():
         dst = jax.lax.rem(i, DEPTH)
-        for c in item_dmas(i, dst):
-            c.wait()
+        _gated_dmas(item_dmas(i, dst), "wait")
         qb = q_ref[0]                            # [H, K*d] zero-padded
         if quantized:                 # int rows, per-token dequant scales
-            if kv_bits == 4:          # nibble-unpack per-head lane slabs
-                kb = _unpack_int4_lanes(
-                    kbuf[dst].astype(jnp.float32), K, d).astype(qb.dtype)
-                vb = _unpack_int4_lanes(
-                    vbuf[dst].astype(jnp.float32), K, d).astype(qb.dtype)
-            else:
-                kb = kbuf[dst].astype(qb.dtype)
-                vb = vbuf[dst].astype(qb.dtype)
             sc = sbuf[pl.ds(dst * G, G), 0]      # [G, 2*bs] f32
             sck = sc[:, :bs].reshape(1, G * bs)
             scv = sc[:, bs:].reshape(1, G * bs)
+        if quantized and kv_bits == 8:
+            # qb int8 [H, K*d], kb raw int8: exact integer dot, dequant on
+            # the [H, G*bs] scores (q row scale x per-token k scale)
+            s = jax.lax.dot_general(qb, kbuf[dst], (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            s = (s.astype(jnp.float32) * (qs_ref[0][:, :1] * scale)) * sck
+            vb = vbuf[dst].astype(jnp.bfloat16)
         else:
-            kb = kbuf[dst]                       # [G*bs, K*d]
-            vb = vbuf[dst]
-        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if quantized:
-            s = s * sck
+            if quantized:             # int4: nibble-unpack, global pairing
+                kb = _unpack_int4_lanes(kbuf[dst], K, d).astype(qb.dtype)
+                vb = _unpack_int4_lanes(vbuf[dst], K, d).astype(qb.dtype)
+            else:
+                kb = kbuf[dst]                   # [G*bs, K*d]
+                vb = vbuf[dst]
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * scale
+            if quantized:
+                s = s * sck
         pos0 = pos0_ref[a]
         colpos = ((lo_ref[a] + g * G) * bs
                   + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
@@ -456,8 +505,8 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
     # list would starve the pipeline.
     @pl.when(item_active(i + DEPTH))
     def _prefetch():
-        for c in item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)):
-            c.start()
+        _gated_dmas(item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)),
+                    "start")
 
     @pl.when(g == ng_ref[a] - 1)
     def _finalize():
@@ -495,7 +544,8 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
                                    kv_bits=kv_bits)
     G = _DECODE_G
     NG = max(1, -(-nb_max // G))
-    pos0, lo, ng = _past_ranges(atom_pos0, row_pos, bs, nb_max, G, window)
+    pos0, lo, nblk, ng = _past_ranges(atom_pos0, row_pos, bs, nb_max, G,
+                                      window)
 
     # zero-padded q_big: head h lives in lane block h//rep
     hsel = (jnp.arange(K)[None, :] == (jnp.arange(H) // rep)[:, None])
@@ -503,6 +553,12 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     q_big = q_big.reshape(A, H, K * d)
     if q_big.dtype not in (jnp.bfloat16, jnp.float32):
         q_big = q_big.astype(jnp.bfloat16)
+    q_int = quantized and kv_bits == 8
+    if q_int:
+        # per-(atom, head) int8 q for the integer score dot; the zero
+        # padding survives exactly (0/scale == 0)
+        q_big, qs = _quantize_q_rows(q_big)
+        qs_pad = jnp.broadcast_to(qs, (A, H, 128)).astype(jnp.float32)
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, bs=bs, K=K, rep=rep, nb_max=nb_max,
@@ -522,13 +578,17 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
         pltpu.VMEM((H, d), jnp.float32),
     ]
     operands = [q_big, k_pool, v_pool]
+    if q_int:
+        in_specs.insert(1, pl.BlockSpec((1, H, 128),
+                                        lambda i, *_: (i // NG, 0, 0)))
+        operands.insert(1, qs_pad)
     if quantized:
-        in_specs.insert(3, pl.BlockSpec(memory_space=pl.ANY))
+        in_specs.insert(4 if q_int else 3, pl.BlockSpec(memory_space=pl.ANY))
         scratch.insert(2, pltpu.VMEM((_DMA_DEPTH * G, 1, 2 * bs),
                                      jnp.float32))
         operands.append(kv_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=7,
+        num_scalar_prefetch=8,
         grid=(A * NG,),
         in_specs=in_specs,
         out_specs=[
@@ -547,8 +607,8 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
         ],
         interpret=interpret,
     )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32), pos0,
-      row_pos.astype(jnp.int32), lo, ng, block_tables.astype(jnp.int32),
-      *operands)
+      row_pos.astype(jnp.int32), lo, nblk, ng,
+      block_tables.astype(jnp.int32), *operands)
     return acc, m_p[..., 0], l_p[..., 0]
 
 
@@ -590,6 +650,11 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
         scv = sc[..., bs:].reshape(A, S)
         kd = kd.astype(jnp.float32) * sck[..., None, None]
         vd = vd.astype(jnp.float32) * scv[..., None, None]
+        if kv_bits == 8:
+            # mirror the kernel's int8 q (per-(atom, head) scale) so the
+            # twin computes the same q-hat semantics
+            qi, qs = _quantize_q_rows(q)
+            q = qi.astype(jnp.float32) * qs
     if K != H:
         kd = jnp.repeat(kd, rep, axis=2)
         vd = jnp.repeat(vd, rep, axis=2)
@@ -689,12 +754,12 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
     """Prefill-past partials: one work item = G past blocks of one chunk
     atom, per-kv-head score/update loops over [R=tq*rep, G*bs] tiles."""
     if quantized:
-        (li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
-         kpool, vpool, spool, acc_ref, m_ref, l_ref,
+        (li_ref, slot_ref, pos0_ref, lo_ref, nblk_ref, ng_ref, bt_ref,
+         q_ref, kpool, vpool, spool, acc_ref, m_ref, l_ref,
          kbuf, vbuf, sbuf, dsem, m_scr, l_scr, acc_scr) = refs
     else:
-        (li_ref, slot_ref, pos0_ref, lo_ref, ng_ref, bt_ref, q_ref,
-         kpool, vpool, acc_ref, m_ref, l_ref,
+        (li_ref, slot_ref, pos0_ref, lo_ref, nblk_ref, ng_ref, bt_ref,
+         q_ref, kpool, vpool, acc_ref, m_ref, l_ref,
          kbuf, vbuf, dsem, m_scr, l_scr, acc_scr) = refs
         spool = sbuf = None
     i = pl.program_id(0)
@@ -706,16 +771,20 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
     a = i // NG
     g = jax.lax.rem(i, NG)
     item_dmas, item_active = _worklist_helpers(
-        n_items, NG, G, bs, nb_max, slot_ref, lo_ref, ng_ref, bt_ref, li_ref,
-        kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
+        n_items, NG, G, bs, nb_max, slot_ref, nblk_ref, lo_ref, ng_ref,
+        bt_ref, li_ref, kpool, vpool, kbuf, vbuf, dsem, spool, sbuf)
 
     @pl.when(i == 0)
     def _warmup():
+        # stale VMEM must start finite under gated DMAs (see _decode_kernel)
+        kbuf[:] = jnp.zeros_like(kbuf)
+        vbuf[:] = jnp.zeros_like(vbuf)
+        if sbuf is not None:
+            sbuf[:] = jnp.zeros_like(sbuf)
         for joff in range(DEPTH):
             @pl.when(item_active(joff))
             def _issue(_j=joff):
-                for c in item_dmas(_j, _j % DEPTH):
-                    c.start()
+                _gated_dmas(item_dmas(_j, _j % DEPTH), "start")
 
     @pl.when(g == 0)
     def _init():
@@ -728,8 +797,7 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
     @pl.when(active)
     def _compute():
         dst = jax.lax.rem(i, DEPTH)
-        for c in item_dmas(i, dst):
-            c.wait()
+        _gated_dmas(item_dmas(i, dst), "wait")
         pos0 = pos0_ref[a]
         colpos = ((lo_ref[a] + g * G) * bs
                   + jax.lax.broadcasted_iota(jnp.int32, (R, G * bs), 1))
@@ -743,10 +811,10 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
             sck = sc[:, :bs].reshape(1, G * bs)
             scv = sc[:, bs:].reshape(1, G * bs)
         if quantized and kv_bits == 4:
-            # unpack the whole [G*bs, K*d/2] tile once (per-head pairing),
-            # then per-head slabs slice the unpacked lanes
-            kfull = _unpack_int4_lanes(kbuf[dst].astype(jnp.float32), K, d)
-            vfull = _unpack_int4_lanes(vbuf[dst].astype(jnp.float32), K, d)
+            # unpack the whole [G*bs, K*d/2] tile once (global lane
+            # pairing), then per-head slabs slice the unpacked lanes
+            kfull = _unpack_int4_lanes(kbuf[dst], K, d)
+            vfull = _unpack_int4_lanes(vbuf[dst], K, d)
         for kk in range(K):
             qk = q_ref[0, kk]                    # [R, d]
             if quantized and kv_bits == 4:
@@ -779,8 +847,8 @@ def _past_kernel(*refs, scale: float, bs: int, tq: int, K: int, rep: int,
 
     @pl.when(item_active(i + DEPTH))
     def _prefetch():
-        for c in item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)):
-            c.start()
+        _gated_dmas(item_dmas(i + DEPTH, jax.lax.rem(i + DEPTH, DEPTH)),
+                    "start")
 
     @pl.when(g == ng_ref[a] - 1)
     def _finalize():
@@ -871,8 +939,8 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
         G = _PAST_G
         NG = max(1, -(-nb_max // G))
         # the OLDEST query row (position pos0) governs the window's lo block
-        pos0, lo, ng = _past_ranges(atom_pos0, atom_pos0, bs, nb_max, G,
-                                    window)
+        pos0, lo, nblk, ng = _past_ranges(atom_pos0, atom_pos0, bs,
+                                          nb_max, G, window)
         # q in per-kv-head row blocks: [A, K, R=tq*rep, d], row r=(t, rr)
         qk = (q.reshape(A, tq, K, rep, d).transpose(0, 2, 1, 3, 4)
               .reshape(A, K, R, d))
@@ -901,7 +969,7 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
                                          jnp.float32))
             operands.append(kv_scale)
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=6,
+            num_scalar_prefetch=7,
             grid=(A * NG,),
             in_specs=in_specs,
             out_specs=[
@@ -920,7 +988,7 @@ def _prefill_attention(q, k_self, v_self, k_pool, v_pool, layer,
             ],
             interpret=interpret,
         )(layer.reshape(1).astype(jnp.int32), atom_slot.astype(jnp.int32),
-          pos0, lo, ng, block_tables.astype(jnp.int32), *operands)
+          pos0, lo, nblk, ng, block_tables.astype(jnp.int32), *operands)
 
         def to_hq(x):  # [A, K, (tq, rep), c] -> [A, H=K*rep, tq, c]
             c = x.shape[-1]
@@ -1171,8 +1239,9 @@ def packed_kv_append_quant(pool: jax.Array, scale_pool: jax.Array,
     :func:`_unpack_int4_lanes`); ``scale_pool`` f32 [L, nb+1, 1, 2*bs]
     holding per-token dequant scales (k rows in lanes [0, bs), v in
     [bs, 2bs) — ``which`` 0/1 selects the half); ``new_rows`` float
-    [L, N, K, d] or [L, N, K*d]. ``bits=4`` needs the 4-D rows form (the
-    per-head lane pairing needs K and d). Each row is quantized ONCE with
+    [L, N, K, d] or [L, N, K*d] (either form — the int4 lane pairing is
+    GLOBAL, byte j = features j and j + K*d/2, so only the flattened K*d
+    width matters). Each row is quantized ONCE with
     its own amax/qmax scale and never requantized — per-token granularity
     is what makes incremental block filling exact. Under tensor
     parallelism the amax over the (sharded) head dim is an automatic GSPMD
