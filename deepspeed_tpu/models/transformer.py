@@ -407,12 +407,12 @@ def attention_block(x: jax.Array, w: Params, cfg: TransformerConfig,
                     positions: Optional[jax.Array] = None) -> jax.Array:
     B, T, D = x.shape
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-    if (cfg.attention_impl == "fpdt" and positions is None
-            and cfg.sliding_window is None):
+    if cfg.attention_impl == "fpdt" and positions is None:
         # fused per-chunk-projection tier: q/k/v never materialize full-T
-        # (sequence/fpdt.py module docstring). Falls through to the seam
-        # path (full-T projection + chunked fpdt_attention) only when T is
-        # too short to chunk.
+        # (sequence/fpdt.py module docstring), incl. windowed families
+        # (mistral/qwen2 — static-chunk-distance pair loop). Falls through
+        # to the seam path (full-T projection + chunked fpdt_attention)
+        # only when T is too short to chunk.
         from deepspeed_tpu.sequence.fpdt import fpdt_block_attention
 
         o = fpdt_block_attention(x, w, cfg, freqs)

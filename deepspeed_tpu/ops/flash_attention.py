@@ -51,7 +51,9 @@ def _on_tpu() -> bool:
 def _block_live(causal, window, q_start, k_start, block_q, block_k):
     """Per-tile liveness predicate for ``pl.when`` (q_start/k_start are traced
     program-id products): dead when entirely above the causal diagonal or
-    entirely older than the sliding window."""
+    entirely older than the sliding window. Callers fold any static
+    rel_offset (a global q-position shift for chunk-pair masking) into
+    q_start before calling — same convention as _bwd_mask."""
     live = True
     if causal:
         live = k_start <= q_start + block_q - 1
@@ -63,7 +65,7 @@ def _block_live(causal, window, q_start, k_start, block_q, block_k):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 scale: float, causal: bool, window, block_q: int,
-                block_k: int):
+                block_k: int, rel_offset: int = 0):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -73,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q_start = iq * block_q
+    q_start = iq * block_q + rel_offset
     k_start = ik * block_k
     live = _block_live(causal, window, q_start, k_start, block_q, block_k)
 
@@ -117,14 +119,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _fwd_pallas(q, k, v, *, scale, causal, window, block_q, block_k,
-                interpret):
+                interpret, rel_offset=0):
     B, H, T, d = q.shape
     S, K = k.shape[2], k.shape[1]
     rep = H // K
     nq, nk = T // block_q, S // block_k
     grid = (B, H, nq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               window=window, block_q=block_q, block_k=block_k)
+                               window=window, block_q=block_q, block_k=block_k,
+                               rel_offset=rel_offset)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -156,6 +159,7 @@ def _fwd_pallas(q, k, v, *, scale, causal, window, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 def _bwd_mask(s, causal, window, q_start, k_start):
+    # callers fold any static rel_offset into q_start
     if not causal and window is None:
         return s
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
@@ -167,10 +171,10 @@ def _bwd_mask(s, causal, window, q_start, k_start):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                   scale, causal, window, block_q, block_k):
+                   scale, causal, window, block_q, block_k, rel_offset=0):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
-    q_start, k_start = iq * block_q, ik * block_k
+    q_start, k_start = iq * block_q + rel_offset, ik * block_k
 
     @pl.when(ik == 0)
     def _init():
@@ -203,10 +207,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, causal, window, block_q, block_k):
+                    scale, causal, window, block_q, block_k, rel_offset=0):
     ik, iq = pl.program_id(2), pl.program_id(3)  # kv-blocks outer, q-blocks inner
     nq = pl.num_programs(3)
-    q_start, k_start = iq * block_q, ik * block_k
+    q_start, k_start = iq * block_q + rel_offset, ik * block_k
 
     @pl.when(iq == 0)
     def _init():
@@ -243,7 +247,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, window, block_q,
-                block_k, interpret, dlse=None):
+                block_k, interpret, dlse=None, rel_offset=0):
     B, H, T, d = q.shape
     S, K = k.shape[2], k.shape[1]
     rep = H // K
@@ -258,7 +262,8 @@ def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, window, block_q,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          window=window, block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k,
+                          rel_offset=rel_offset),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -277,7 +282,8 @@ def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, window, block_q,
     # dk/dv accumulate over q blocks, per Q-head; GQA-sum folded after.
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          window=window, block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k,
+                          rel_offset=rel_offset),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
@@ -346,26 +352,32 @@ def _flash_bwd(causal, window, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, window, block_q, block_k, interpret):
-    out, res = _flash_fwd(q, k, v, causal, window, block_q, block_k,
-                          interpret)
-    return out, res[-1]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, window, block_q, block_k, interpret,
+               rel_offset=0):
+    out_lse, _ = _flash_lse_fwd(q, k, v, causal, window, block_q, block_k,
+                                interpret, rel_offset)
+    return out_lse
 
 
-def _flash_lse_fwd(q, k, v, causal, window, block_q, block_k, interpret):
-    out, res = _flash_fwd(q, k, v, causal, window, block_q, block_k,
-                          interpret)
-    return (out, res[-1]), res
+def _flash_lse_fwd(q, k, v, causal, window, block_q, block_k, interpret,
+                   rel_offset=0):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                           window=window, block_q=block_q, block_k=block_k,
+                           interpret=interpret, rel_offset=rel_offset)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, window, block_q, block_k, interpret, res, ct):
+def _flash_lse_bwd(causal, window, block_q, block_k, interpret, rel_offset,
+                   res, ct):
     do, dlse = ct
     q, k, v, out, lse = res
     scale = 1.0 / math.sqrt(q.shape[-1])
     dq, dk, dv = _bwd_pallas(q, k, v, out, lse, do, scale=scale,
                              causal=causal, window=window, block_q=block_q,
-                             block_k=block_k, interpret=interpret, dlse=dlse)
+                             block_k=block_k, interpret=interpret, dlse=dlse,
+                             rel_offset=rel_offset)
     return dq, dk, dv
 
 
@@ -374,6 +386,8 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = True,
+                        window: Optional[int] = None,
+                        rel_offset: int = 0,
                         block_q: int = DEFAULT_BLOCK_Q,
                         block_k: int = DEFAULT_BLOCK_K,
                         interpret: Optional[bool] = None):
@@ -385,7 +399,12 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     two chunk results merge exactly via
     ``m=max(l1,l2); o=(e^{l1-m} o1 + e^{l2-m} o2)/(e^{l1-m}+e^{l2-m})``.
     GQA is native — k/v keep their K heads, the kernel maps query head h
-    to kv head h//(H/K)."""
+    to kv head h//(H/K).
+
+    ``rel_offset`` (STATIC) shifts every q row's global position by that
+    many tokens relative to k row 0 — with ``causal``/``window`` this masks
+    a (q-chunk, kv-chunk) pair at chunk distance ``rel_offset`` exactly as
+    the full sequence would (the fused FPDT tier's sliding-window path)."""
     if interpret is None:
         interpret = not _on_tpu()
     T, S = q.shape[1], k.shape[1]
@@ -394,7 +413,8 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out, lse = _flash_lse(qt, kt, vt, causal, None, bq, bk, interpret)
+    out, lse = _flash_lse(qt, kt, vt, causal, window, bq, bk, interpret,
+                          int(rel_offset))
     return out.transpose(0, 2, 1, 3), lse
 
 

@@ -1241,7 +1241,13 @@ def packed_kv_append_quant(pool: jax.Array, scale_pool: jax.Array,
     [bs, 2bs) — ``which`` 0/1 selects the half); ``new_rows`` float
     [L, N, K, d] or [L, N, K*d] (either form — the int4 lane pairing is
     GLOBAL, byte j = features j and j + K*d/2, so only the flattened K*d
-    width matters). Each row is quantized ONCE with
+    width matters). Known accuracy limit at ``bits=4``: the single
+    per-token amax spans every kv head's features, so one outlier head
+    costs the rest resolution (15 levels); the upgrade path is per-head K
+    scales (``kv_scale`` lanes [K, 2*bs], score dequant per (row-block,
+    column)) — V scales must stay per-token because the pv contraction
+    mixes columns before the per-head output lanes separate. Each row is
+    quantized ONCE with
     its own amax/qmax scale and never requantized — per-token granularity
     is what makes incremental block filling exact. Under tensor
     parallelism the amax over the (sharded) head dim is an automatic GSPMD

@@ -194,6 +194,21 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return outs.transpose(1, 0, 2, 3, 4).reshape(B, T, H, d)
 
 
+def _merge_norm(carry, pair):
+    """Normalized-output merge of two flash results: exact because lse
+    carries each side's softmax mass."""
+    o_run, l_run = carry
+    o_j, l_j = pair
+    m = jnp.maximum(l_run, l_j)
+    w1 = jnp.exp(l_run - m)                     # [B, H, c, 1]
+    w2 = jnp.exp(l_j - m)
+    tot = w1 + w2
+    w1t = (w1 / tot).transpose(0, 2, 1, 3)
+    w2t = (w2 / tot).transpose(0, 2, 1, 3)
+    o = o_run * w1t + o_j.astype(jnp.float32) * w2t
+    return o, m + jnp.log(tot)
+
+
 def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
                          *, chunk: Optional[int] = None) -> Optional[jax.Array]:
     """Fused per-chunk-projection FPDT attention block (module docstring).
@@ -209,6 +224,7 @@ def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
     """
     B, T, D = x.shape
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    W = getattr(cfg, "sliding_window", None)
     c = min(chunk or getattr(cfg, "fpdt_chunk", None) or BLOCK_CHUNK, T)
     if T % c:
         c = max(d_ for d_ in range(1, c + 1) if T % d_ == 0)
@@ -218,10 +234,10 @@ def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is not None and not mesh.empty \
             and mesh.shape.get("sp", 1) > 1:
-        # chunk slicing over an sp-sharded T would turn every pair into a
-        # cross-shard gather; under SP the seam path (full-T projection +
-        # ulysses/fpdt attention impl) is the right composition
-        return None
+        # sp-sharded T: the ring composition rotates residual-stream
+        # BLOCKS over the sp axis and recomputes KV per visit — full-T
+        # q/k/v never materialize on any shard
+        return fpdt_block_attention_sp(x, w, cfg, freqs, chunk=chunk)
     has_b = "bq" in w
 
     def _pos(i):
@@ -250,39 +266,49 @@ def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
         if cfg.use_rope:
             qi = apply_rope(qi, freqs, _pos(i))
 
-        def merge(carry, pair):
-            # normalized-output merge of two flash results: exact because
-            # lse carries each side's softmax mass
-            o_run, l_run = carry
-            o_j, l_j = pair
-            m = jnp.maximum(l_run, l_j)
-            w1 = jnp.exp(l_run - m)             # [B, H, c, 1]
-            w2 = jnp.exp(l_j - m)
-            tot = w1 + w2
-            w1t = (w1 / tot).transpose(0, 2, 1, 3)
-            w2t = (w2 / tot).transpose(0, 2, 1, 3)
-            o = o_run * w1t + o_j.astype(jnp.float32) * w2t
-            return o, m + jnp.log(tot)
-
-        def kv_step(j, carry):
-            # each pair runs the training-grade flash kernel (VMEM-tiled,
-            # GQA-native — no repeated KV, no [c, c] score tile in HBM);
-            # the diagonal pair is the only one needing the causal mask
-            def pair(carry, causal):
-                return merge(carry, flash_attention_lse(
-                    qi, *kv_chunk(j), causal=causal))
-
-            return lax.cond(
-                j < i, lambda cr: pair(cr, False),
-                lambda cr: lax.cond(j == i, lambda c_: pair(c_, True),
-                                    lambda c_: c_, cr), carry)
+        merge = _merge_norm
 
         o0 = jnp.zeros((B, c, H, hd), jnp.float32)
         l0 = jnp.full((B, H, c, 1), -1e30, jnp.float32)
-        # per-pair remat (see fpdt_attention.kv_step): without it autodiff
-        # saves the per-pair recomputed KV and flash residuals
-        kv_step = jax.checkpoint(kv_step, static_argnums=())
-        o, _ = lax.fori_loop(0, nc, kv_step, (o0, l0))
+        if W is None:
+            def kv_step(j, carry):
+                # each pair runs the training-grade flash kernel (VMEM-
+                # tiled, GQA-native — no repeated KV, no [c, c] score tile
+                # in HBM); the diagonal pair alone needs the causal mask
+                def pair(carry, causal):
+                    return merge(carry, flash_attention_lse(
+                        qi, *kv_chunk(j), causal=causal))
+
+                return lax.cond(
+                    j < i, lambda cr: pair(cr, False),
+                    lambda cr: lax.cond(j == i, lambda c_: pair(c_, True),
+                                        lambda c_: c_, cr), carry)
+
+            # per-pair remat (see fpdt_attention.kv_step): without it
+            # autodiff saves the per-pair recomputed KV + flash residuals
+            kv_step = jax.checkpoint(kv_step, static_argnums=())
+            o, _ = lax.fori_loop(0, nc, kv_step, (o0, l0))
+        else:
+            # sliding window: only chunks within ceil-distance of the
+            # window are visible, so the pair loop runs over STATIC chunk
+            # distances dd (giving each pair a static rel_offset for the
+            # kernel's global-position mask) — compute and working set
+            # scale with T*W, matching the reference's windowed families
+            # (mistral/qwen2) under fpdt_layer.py:545-style chunking
+            carry = (o0, l0)
+            dd_max = min((W + c - 2) // c, nc - 1)
+            for dd in range(dd_max + 1):
+                causal = dd == 0
+                win = W if (dd + 1) * c > W else None  # interior: no mask
+
+                def pair(cr, dd=dd, causal=causal, win=win):
+                    return merge(cr, flash_attention_lse(
+                        qi, *kv_chunk(i - dd), causal=causal, window=win,
+                        rel_offset=dd * c))
+
+                pair = jax.checkpoint(pair)
+                carry = lax.cond(i - dd >= 0, pair, lambda cr: cr, carry)
+            o, _ = carry
         o = linear(o.astype(x.dtype).reshape(B, c, H * hd), w["wo"])
         return o + w["bo"] if "bo" in w else o
 
@@ -293,3 +319,186 @@ def fpdt_block_attention(x: jax.Array, w, cfg, freqs: Optional[jax.Array],
 
     _, outs = lax.scan(outer, None, jnp.arange(nc))
     return outs.transpose(1, 0, 2, 3).reshape(B, T, D)
+
+
+def fpdt_block_attention_sp(x: jax.Array, w, cfg, freqs, *, axis: str = "sp",
+                            chunk: Optional[int] = None
+                            ) -> Optional[jax.Array]:
+    """Fused per-chunk-projection FPDT under sequence parallelism.
+
+    TPU-native ring composition (reference ``fpdt_layer.py:545`` scales the
+    host-streamed tier across ranks; here the ``sp`` shards form a
+    ``ppermute`` ring): each shard owns T/sp residual-stream tokens and its
+    q chunks; at ring step ``s`` the shard holds the residual block of
+    shard ``r-s`` and recomputes that block's K/V chunk-by-chunk at the
+    point of use. What travels the ring is the RESIDUAL block ([B, T/sp,
+    D]) — not K/V — so ICI volume matches a KV ring for GQA shapes while
+    no shard ever materializes full-T q/k/v. Causality makes blocks from
+    ``r-s < 0`` invalid: the whole visit sits under ``lax.cond`` (no
+    collectives inside), so invalid visits cost nothing.
+
+    Sliding windows reuse the single-device static-chunk-distance trick:
+    at ring step ``s`` the global chunk distance of pair (i, j) is
+    ``s*nc + i - j`` — looping a STATIC ``dd`` band intersected with the
+    window bound gives every pair a static ``rel_offset``; whole blocks
+    beyond the window are skipped at trace time."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.flash_attention import flash_attention_lse
+
+    mesh = jax.sharding.get_abstract_mesh()
+    sp = mesh.shape[axis]
+    B, T, D = x.shape
+    hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    W = getattr(cfg, "sliding_window", None)
+    Tl = T // sp
+    c = min(chunk or getattr(cfg, "fpdt_chunk", None) or BLOCK_CHUNK, Tl)
+    if Tl % c:
+        c = max(d_ for d_ in range(1, c + 1) if Tl % d_ == 0)
+    nc = Tl // c
+    if nc < 1 or c < 64:
+        return None
+    has_b = "bq" in w
+    dd_max = None if W is None else (W + c - 2) // c
+
+    cdt = jnp.dtype(cfg.dtype)
+
+    def shard_fn(xl, w, freqs):
+        # bf16 replicated-in weights whose grads psum at the boundary trip
+        # XLA:CPU's AllReducePromotion (round-3 note) — weights cross the
+        # boundary fp32 and cast to the compute dtype HERE
+        w = jax.tree_util.tree_map(lambda p: p.astype(cdt), w)
+        r = jax.lax.axis_index(axis)
+        base = r * Tl
+
+        def pos(j, src_base):
+            return jnp.broadcast_to(
+                src_base + j * c + jnp.arange(c)[None], (B, c))
+
+        def kv_chunk(xs, j, src_base):
+            xj = lax.dynamic_slice_in_dim(xs, j * c, c, axis=1)
+            kj, vj = linear(xj, w["wk"]), linear(xj, w["wv"])
+            if has_b:
+                kj, vj = kj + w["bk"], vj + w["bv"]
+            kj = kj.reshape(B, c, K, hd)
+            vj = vj.reshape(B, c, K, hd)
+            if cfg.use_rope:
+                kj = apply_rope(kj, freqs, pos(j, src_base))
+            return kj, vj
+
+        def q_of(i):
+            xi = lax.dynamic_slice_in_dim(xl, i * c, c, axis=1)
+            qi = linear(xi, w["wq"])
+            if has_b:
+                qi = qi + w["bq"]
+            qi = qi.reshape(B, c, H, hd)
+            if cfg.use_rope:
+                qi = apply_rope(qi, freqs, pos(i, base))
+            return qi
+
+        def attend_block(o_st, l_st, xv, s, src_base):
+            """Merge every visible (local q chunk i, chunk j of xv) pair
+            into the stacked carry. ``s`` (ring step) is STATIC."""
+            S_off = s * nc                     # global chunk distance base
+
+            def per_q(_, xs):
+                i, oi, li = xs
+                qi = q_of(i)
+                carry = (oi, li)
+                if W is None and s > 0:
+                    # visiting block entirely in the past: every chunk
+                    # visible, no masks at all
+                    for j in range(nc):
+                        def pair(cr, j=j):
+                            return _merge_norm(cr, flash_attention_lse(
+                                qi, *kv_chunk(xv, j, src_base),
+                                causal=False))
+                        carry = jax.checkpoint(pair)(carry)
+                elif W is None:
+                    def kv_step(j, cr):
+                        def pair(cr):
+                            return _merge_norm(cr, flash_attention_lse(
+                                qi, *kv_chunk(xv, j, src_base),
+                                causal=False))
+
+                        def diag(cr):
+                            return _merge_norm(cr, flash_attention_lse(
+                                qi, *kv_chunk(xv, j, src_base),
+                                causal=True))
+
+                        return lax.cond(
+                            j < i, pair,
+                            lambda cr: lax.cond(j == i, diag,
+                                                lambda c_: c_, cr), cr)
+
+                    kv_step = jax.checkpoint(kv_step, static_argnums=())
+                    carry = lax.fori_loop(0, nc, kv_step, carry)
+                else:
+                    dd_lo = max(S_off - (nc - 1), 0)
+                    dd_hi = min(S_off + nc - 1, dd_max)
+                    for dd in range(dd_lo, dd_hi + 1):
+                        causal = dd == 0
+                        win = W if (dd + 1) * c > W else None
+
+                        def pair(cr, dd=dd, causal=causal, win=win):
+                            j = i - (dd - S_off)
+                            return _merge_norm(cr, flash_attention_lse(
+                                qi, *kv_chunk(xv, j, src_base),
+                                causal=causal, window=win,
+                                rel_offset=dd * c))
+
+                        j_ok = (i - (dd - S_off) >= 0) \
+                            & (i - (dd - S_off) < nc)
+                        carry = lax.cond(j_ok, jax.checkpoint(pair),
+                                         lambda cr: cr, carry)
+                return None, carry
+
+            # remat per q chunk like the single-device tier: without it
+            # the scan saves every chunk's q projection for every ring
+            # visit (~sp x a full-T q per shard in backward)
+            _, (o2, l2) = lax.scan(jax.checkpoint(per_q), None,
+                                   (jnp.arange(nc), o_st, l_st))
+            return o2, l2
+
+        o = jnp.zeros((nc, B, c, H, hd), jnp.float32)
+        l = jnp.full((nc, B, H, c, 1), -1e30, jnp.float32)
+        o, l = attend_block(o, l, xl, 0, base)          # intra-shard
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        xv = xl
+        for s in range(1, sp):
+            xv = jax.lax.ppermute(xv, axis, perm)       # after s shifts: the block of shard r-s
+            src_base = (r - s) * Tl
+
+            def visit(ol, xv=xv, s=s, src_base=src_base):
+                return attend_block(*ol, xv, s, src_base)
+
+            # blocks from r-s < 0 are in the future: skip the whole visit
+            # (flash has no collectives, so cond is safe here)
+            o, l = lax.cond(r >= s, visit, lambda ol: ol, (o, l))
+        out = o.astype(x.dtype).transpose(1, 0, 2, 3, 4) \
+            .reshape(B, Tl, H * hd)
+        out = linear(out, w["wo"])
+        if "bo" in w:
+            out = out + w["bo"]
+        return out
+
+    # w/freqs enter as EXPLICIT args (replicated w.r.t. the manual sp axis,
+    # auto elsewhere): closure-captured device arrays inside a
+    # partial-manual region trip a context-mesh/axis-type mismatch on the
+    # engine's full mesh
+    if freqs is None:
+        freqs_arg = jnp.zeros((1,), jnp.float32)
+        fn = lambda xl, w, _f: shard_fn(xl, w, None)     # noqa: E731
+    else:
+        freqs_arg = freqs
+        fn = shard_fn
+    w_in = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if p.dtype == jnp.bfloat16 else p, w)
+    w_specs = jax.tree_util.tree_map(lambda _: P(), w_in)
+    return jax.shard_map(
+        fn,
+        in_specs=(P(None, axis, None), w_specs, P()),
+        out_specs=P(None, axis, None),
+        axis_names={axis},
+        check_vma=False,
+    )(x, w_in, freqs_arg)
