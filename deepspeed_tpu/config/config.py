@@ -387,11 +387,21 @@ class DataEfficiencyConfig(DSTpuConfigModel):
 
 
 class ProgressiveLayerDropConfig(DSTpuConfigModel):
-    """``progressive_layer_drop`` section (reference config schema)."""
+    """``progressive_layer_drop`` section (reference config schema).
+
+    ``compiled_tiers`` (TPU extension) > 0 selects the STATIC-DEPTH mode:
+    theta's expected kept-layer count quantizes onto that many compiled
+    depth tiers and the train step runs only the first k layers — the
+    reference's wall-clock saving (layers actually skipped), at the price
+    of one recompile per tier instead of per-step stochastic depth. 0
+    keeps the gated-residual mode (regularization parity, no saving —
+    data-dependent layer skips cannot save wall-clock under XLA's static
+    compilation)."""
 
     enabled: bool = False
     theta: float = 0.5
     gamma: float = 0.001
+    compiled_tiers: int = 0
 
 
 class HybridEngineConfig(DSTpuConfigModel):

@@ -599,6 +599,11 @@ class TransformerLM:
         # the keep schedule and rebuilds its jits when the bucket changes.
         self._ltd_keep: Optional[int] = None
         self._ltd_layers: Optional[tuple] = None
+        # progressive-layer-drop static-depth mode: when set (< num_layers)
+        # the TRAIN forward runs only the first k layers — the engine owns
+        # the theta->depth tier schedule and rebuilds its jits on change
+        # (one recompile per tier; the reference's actual wall-clock saving)
+        self._pld_depth: Optional[int] = None
 
     def set_random_ltd(self, keep: Optional[int],
                        layers: Optional[tuple] = None) -> None:
@@ -607,6 +612,12 @@ class TransformerLM:
         if keep is not None:
             start, end = layers if layers is not None else (1, L - 1)
             self._ltd_layers = (max(0, start), end if end > 0 else L - 1)
+
+    def set_pld_depth(self, k: Optional[int]) -> None:
+        if k is not None and not (1 <= k <= self.cfg.num_layers):
+            raise ValueError(f"pld depth {k} out of [1, "
+                             f"{self.cfg.num_layers}]")
+        self._pld_depth = k
 
     # ---- init -------------------------------------------------------------
     def init(self, rng: jax.Array) -> Params:
@@ -752,6 +763,15 @@ class TransformerLM:
         T = input_ids.shape[1]
         ltd_keep = self._ltd_keep
         ltd = ltd_keep is not None and ltd_keep < T
+        kpld = self._pld_depth
+        if (kpld is not None and kpld < cfg.num_layers and len(segs) == 1
+                and not ltd):
+            # static-depth PLD: run only the first k layers (real compute
+            # saving — the gated-residual mode below computes every layer)
+            layers = jax.tree_util.tree_map(lambda p: p[:kpld], layers)
+            n_layers_run = kpld
+        else:
+            n_layers_run = cfg.num_layers
         if len(segs) > 1:
             if ltd or pld_theta is not None:
                 raise NotImplementedError(
@@ -847,7 +867,7 @@ class TransformerLM:
             aux_total = jnp.sum(auxes)
         else:
             aux_total = jnp.zeros((), jnp.float32)
-            for i in range(cfg.num_layers):
+            for i in range(n_layers_run):
                 xi = jax.tree_util.tree_map(lambda p: p[i], layers)
                 x, aux = body(x, (xi, jnp.int32(i)) if wrapped else xi)
                 aux_total = aux_total + aux

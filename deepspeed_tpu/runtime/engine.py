@@ -226,6 +226,7 @@ class DeepSpeedTpuEngine:
                                  "set_random_ltd (TransformerLM family)")
             self._update_random_ltd()
         self._pld = None
+        self._pld_tiers = 0
         if config.progressive_layer_drop.enabled:
             if self._ltd_cfg is not None:
                 raise ValueError("progressive_layer_drop and random_ltd both "
@@ -236,6 +237,30 @@ class DeepSpeedTpuEngine:
             self._pld = ProgressiveLayerDrop(
                 theta=config.progressive_layer_drop.theta,
                 gamma=config.progressive_layer_drop.gamma)
+            self._pld_tiers = int(config.progressive_layer_drop
+                                  .compiled_tiers)
+            if self._pld_tiers > 0:
+                if getattr(getattr(self.module, "cfg", None),
+                           "window_start_layer", 0):
+                    # the static-depth slice would silently no-op under the
+                    # multi-segment layer loop while still paying a jit
+                    # rebuild per tier change
+                    raise NotImplementedError(
+                        "progressive_layer_drop.compiled_tiers does not "
+                        "support mixed-window models (window_start_layer "
+                        "> 0)")
+                wd = float((config.optimizer.params or {}).get(
+                    "weight_decay", 0.0)) if config.optimizer else 0.0
+                if wd > 0.0:
+                    # decoupled decay updates EVERY param each step; layers
+                    # sliced out of the compiled program stop getting grads
+                    # but would keep decaying toward zero — silent damage
+                    # to the full-depth model
+                    raise ValueError(
+                        "progressive_layer_drop.compiled_tiers requires "
+                        "weight_decay=0: the statically-dropped tail "
+                        "layers receive no gradients but decoupled decay "
+                        "would keep shrinking them every step")
 
         self.training_dataloader = None
         if training_data is not None:
@@ -513,9 +538,13 @@ class DeepSpeedTpuEngine:
         """Per-step routing inputs riding the batch (broadcast per example so
         the fused GA reshape works): the random-LTD/PLD step seed, and the
         progressive-layer-drop theta (a traced scalar — no recompiles as it
-        decays)."""
+        decays). In PLD's compiled-tiers mode the theta maps to a STATIC
+        depth instead (``_update_pld_depth``) and nothing rides the batch."""
         if (self._ltd_cfg is None and self._pld is None) \
                 or not isinstance(batch, dict):
+            return batch
+        if self._pld is not None and self._pld_tiers > 0:
+            self._update_pld_depth()
             return batch
         b = np.asarray(batch["input_ids"]).shape[0]
         out = {**batch, "ltd_seed": np.full((b,), self.global_steps
@@ -524,6 +553,32 @@ class DeepSpeedTpuEngine:
             self._pld.update_state(self.global_steps)
             out["pld_theta"] = np.full((b,), self._pld.get_theta(), np.float32)
         return out
+
+    def _update_pld_depth(self) -> None:
+        """Advance the static-depth PLD tier (compiled_tiers mode): theta's
+        expected kept-layer count quantized onto the tier grid; a tier
+        change rebuilds the jitted programs — one recompile per tier over
+        the run, and each step then RUNS only k layers (the reference's
+        wall-clock saving, expressed as compiled depth instead of
+        per-step stochastic skips)."""
+        from deepspeed_tpu.runtime.progressive_layer_drop import \
+            active_layers
+
+        if not hasattr(self.module, "set_pld_depth"):
+            raise NotImplementedError(
+                "progressive_layer_drop.compiled_tiers requires a "
+                "TransformerLM module (not supported under pipeline "
+                "wrapping)")
+        self._pld.update_state(self.global_steps)
+        k = active_layers(self._pld.get_theta(),
+                          self.module.cfg.num_layers, self._pld_tiers,
+                          theta_min=self._pld.theta)
+        if k != self.module._pld_depth:
+            self.module.set_pld_depth(k)
+            if hasattr(self, "_fused_step_cache"):
+                self._fused_step_cache.clear()
+                self._build_jit_fns()
+                self._refresh_hpz()
 
     def _put_batch(self, batch):
         """Host batch → device arrays laid out over (dp, fsdp) × sp."""

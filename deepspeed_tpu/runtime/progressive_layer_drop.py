@@ -41,3 +41,24 @@ def layer_keep_probs(theta: float, num_layers: int) -> np.ndarray:
     """Per-layer keep probability under the PLD depth ramp."""
     i = np.arange(1, num_layers + 1)
     return 1.0 - (i / num_layers) * (1.0 - theta)
+
+
+def active_layers(theta: float, num_layers: int, tiers: int,
+                  theta_min: float = 0.5) -> int:
+    """Static-depth tier for the compiled-tiers mode: the depth ramp's
+    expected kept-layer count ``sum_i p_i = L - (1-theta)(L+1)/2``,
+    quantized (rounded UP — never less compute than the stochastic
+    expectation) onto ``tiers`` values between the theta_min-floor depth
+    and L. One recompile per tier over the whole run."""
+    L = num_layers
+
+    def expect(t):
+        return L - (1.0 - t) * (L + 1) / 2.0
+
+    k_floor = max(1, int(np.ceil(expect(theta_min))))
+    # tiers=1 degenerates to ONE static depth (k_floor) for the whole run —
+    # a single compile, honoring the one-recompile-per-tier contract
+    grid = np.linspace(k_floor, L, max(tiers, 1))
+    k = grid[min(np.searchsorted(grid, expect(theta) - 1e-9),
+                 max(tiers, 1) - 1)]
+    return int(min(L, max(k_floor, np.ceil(k))))

@@ -128,6 +128,62 @@ def test_pld_engine_training(eight_devices):
     assert losses[-1] < losses[0]
 
 
+def test_pld_compiled_tiers_saves_flops(eight_devices):
+    """compiled_tiers mode (TPU extension): theta maps to a static depth,
+    deeper tiers get DROPPED from the compiled program (the reference's
+    actual wall-clock saving) — compiled FLOPs shrink once theta decays,
+    training stays finite, and the depth schedule is monotone."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.profiling import profile_fn
+    from deepspeed_tpu.runtime.progressive_layer_drop import active_layers
+
+    # schedule sanity: full depth at theta=1, floor at theta_min, monotone
+    ks = [active_layers(t, 16, 4, theta_min=0.5)
+          for t in (1.0, 0.9, 0.75, 0.6, 0.5)]
+    assert ks[0] == 16 and ks == sorted(ks, reverse=True)
+    assert ks[-1] == active_layers(0.5, 16, 4, theta_min=0.5) < 16
+
+    import dataclasses
+
+    # scan_layers=False: XLA cost analysis counts a lax.scan body ONCE
+    # regardless of trip count, which would hide the depth saving
+    cfg8 = dataclasses.replace(get_preset("tiny"), num_layers=8,
+                               scan_layers=False)
+    eng, *_ = ds.initialize(model=TransformerLM(cfg8), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0}, "mesh": {"dp": 8},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 1.0, "compiled_tiers": 3},
+        "steps_per_print": 100})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (16, 32))}
+    depths, losses = [], []
+    for _ in range(4):
+        loss = eng.forward(batch)
+        depths.append(eng.module._pld_depth)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    L = eng.module.cfg.num_layers
+    assert depths[0] == L                      # theta=1 at step 0
+    assert depths[-1] < L                      # gamma=1 decays fast
+    assert depths == sorted(depths, reverse=True)
+    assert np.isfinite(losses[-1])
+    # compiled FLOPs at the truncated depth undercut full depth
+    flops = {}
+    for k in (L, depths[-1]):
+        eng.module.set_pld_depth(k)
+        stats = profile_fn(
+            lambda p, b: eng.module.loss_fn(p, b), eng.params,
+            {"input_ids": np.zeros((2, 32), np.int32)})
+        flops[k] = stats.get("flops", 0)
+    eng.module.set_pld_depth(None)
+    if 0 in flops.values():
+        pytest.skip("backend reports no cost analysis")
+    assert flops[depths[-1]] < 0.9 * flops[L], flops
+
+
 # ---------------------------------------------------------------------------
 # Eigenvalue (Hessian power iteration)
 # ---------------------------------------------------------------------------
