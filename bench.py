@@ -44,12 +44,13 @@ def main() -> None:
         # in-jit microbatch scan amortizes the optimizer + cast over 4x tokens.
         # seq 8192 = Llama-3's native context (the BASELINE.md 8B north-star);
         # measured MFU ladder: 0.543 (b4 s2048 ga1) -> 0.600 (ga4) -> 0.634
-        # (s8192 b1 ga4)
+        # (s8192 b1 ga4) -> 0.646 (ga8); seq 16384 compile-OOMs under this
+        # remat policy
         cfg = TransformerConfig(
             vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
             num_kv_heads=6, max_seq_len=8192, arch="llama",
             remat_policy="dots_and_attn_saveable")
-        batch, ga, seq, steps, warmup = 1, 4, 8192, 8, 2
+        batch, ga, seq, steps, warmup = 1, 8, 8192, 8, 2
     else:  # dev fallback so the harness is runnable anywhere
         cfg = TransformerConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                                 num_heads=4, max_seq_len=256, arch="llama")
