@@ -8,6 +8,10 @@ engine with ``forward/backward/step`` plus data loader and LR scheduler.
 
 from deepspeed_tpu.version import __version__  # noqa: F401
 
+from deepspeed_tpu.utils import jax_compat as _jax_compat
+
+_jax_compat.install()  # older jax: jax.shard_map / sharding.set_mesh shims
+
 from deepspeed_tpu import comm  # noqa: F401
 from deepspeed_tpu import ops  # noqa: F401  (registers Pallas kernels, e.g. 'flash')
 from deepspeed_tpu.accelerator import get_accelerator, set_accelerator  # noqa: F401

@@ -36,6 +36,64 @@ _initialized = False
 # Reduce-op names accepted for parity with the reference's ReduceOp enum.
 SUM, AVG, MAX, MIN, PROD = "sum", "avg", "max", "min", "prod"
 
+# ---------------------------------------------------------------------------
+# Resilience: retry wrapper for host-level collectives
+# ---------------------------------------------------------------------------
+# In-trace collectives are XLA's problem (a failed program re-runs whole);
+# the host-level entries below touch the DCN/coordination plane directly, so
+# they get the RetryPolicy treatment when the resilience layer arms one.
+_retry_policy = None
+_retry_stats = {"retries": 0}
+
+
+def _retryable_exceptions() -> tuple:
+    """What a transient comm-plane failure actually raises: injected faults
+    are OSError, real XLA/DCN failures surface as XlaRuntimeError (a
+    RuntimeError subclass — NOT OSError, so the default retry_on would let
+    them through unretried)."""
+    excs = [OSError]
+    try:
+        from jax._src.lib import xla_extension
+
+        excs.append(xla_extension.XlaRuntimeError)
+    except Exception:  # pragma: no cover - newer jax moves the symbol
+        import jax
+
+        if hasattr(getattr(jax, "errors", None), "JaxRuntimeError"):
+            excs.append(jax.errors.JaxRuntimeError)
+    return tuple(excs)
+
+
+def set_retry_policy(policy) -> None:
+    """Arm (or with None, disarm) retries for host-level collectives —
+    called by the engine from the ``resilience`` config block."""
+    global _retry_policy
+    _retry_policy = policy
+
+
+def get_retry_stats() -> dict:
+    return dict(_retry_stats)
+
+
+def _resilient(name: str, fn, *args, **kwargs):
+    """Run a host collective through the fault-injection hook and, when a
+    policy is armed, the retry loop. Inert (two attribute loads) otherwise."""
+    from deepspeed_tpu.resilience.faults import get_injector
+
+    def call():
+        get_injector().on_collective(name)
+        return fn(*args, **kwargs)
+
+    if _retry_policy is None:
+        return call()
+    from deepspeed_tpu.resilience.retry import retry_call
+
+    def on_retry(_attempt, _exc):
+        _retry_stats["retries"] += 1
+
+    return retry_call(call, policy=_retry_policy, what=f"collective {name}",
+                      retry_on=_retryable_exceptions(), on_retry=on_retry)
+
 
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = False,
@@ -141,7 +199,8 @@ def barrier() -> None:
     """Host-level barrier across processes."""
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+    _resilient("barrier", multihost_utils.sync_global_devices,
+               "deepspeed_tpu.barrier")
 
 
 # ---------------------------------------------------------------------------
@@ -245,13 +304,17 @@ def all_reduce_host(x, op: str = SUM):
 
     arr = jnp.asarray(x)
     if jax.process_count() == 1:
-        return arr
+        # the fault/retry hook still applies (single-process tests drill it)
+        return _resilient("all_reduce_host", lambda: arr)
     if op == SUM:
-        return multihost_utils.process_allgather(arr).sum(axis=0)
+        return _resilient("all_reduce_host",
+                          lambda: multihost_utils.process_allgather(arr).sum(axis=0))
     if op == MAX:
-        return multihost_utils.process_allgather(arr).max(axis=0)
+        return _resilient("all_reduce_host",
+                          lambda: multihost_utils.process_allgather(arr).max(axis=0))
     if op == MIN:
-        return multihost_utils.process_allgather(arr).min(axis=0)
+        return _resilient("all_reduce_host",
+                          lambda: multihost_utils.process_allgather(arr).min(axis=0))
     raise ValueError(op)
 
 
@@ -259,8 +322,11 @@ def broadcast_host(x, src: int = 0):
     from jax.experimental import multihost_utils
 
     if jax.process_count() == 1:
-        return jnp.asarray(x)
-    return multihost_utils.broadcast_one_to_all(jnp.asarray(x), is_source=jax.process_index() == src)
+        return _resilient("broadcast_host", lambda: jnp.asarray(x))
+    return _resilient(
+        "broadcast_host",
+        lambda: multihost_utils.broadcast_one_to_all(
+            jnp.asarray(x), is_source=jax.process_index() == src))
 
 
 def assert_same_across_processes(value, name: str = "value") -> None:
@@ -268,8 +334,11 @@ def assert_same_across_processes(value, name: str = "value") -> None:
     from jax.experimental import multihost_utils
 
     if jax.process_count() == 1:
+        _resilient("assert_same", lambda: None)
         return
-    gathered = multihost_utils.process_allgather(jnp.asarray(value))
+    gathered = _resilient(
+        "assert_same",
+        lambda: multihost_utils.process_allgather(jnp.asarray(value)))
     first = gathered[0]
     if not bool(jnp.all(gathered == first)):
         raise RuntimeError(f"'{name}' differs across processes: {gathered}")

@@ -434,6 +434,43 @@ class ElasticityConfig(DSTpuConfigModel):
     version: float = 0.2
 
 
+class RetryConfig(DSTpuConfigModel):
+    """``resilience.retry``: backoff for checkpoint IO and host collectives."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    deadline_s: Optional[float] = None
+
+
+class ResilienceCheckpointConfig(DSTpuConfigModel):
+    """``resilience.checkpoint``: preemption-safe checkpoint lifecycle."""
+
+    keep_last_k: int = 3
+    verify: bool = True          # manifest+checksum on save, verify on load
+    save_on_preempt: bool = True  # SIGTERM → emergency save at next boundary
+    exit_on_preempt: bool = False
+    preempt_exit_code: int = 42
+
+
+class ResilienceConfig(DSTpuConfigModel):
+    """``resilience`` section: the closed-loop fault-tolerance layer
+    (``deepspeed_tpu/resilience``) — step guard, retries, checkpoint
+    verification/fallback, and deterministic fault injection for drills."""
+
+    enabled: bool = False
+    # consecutive NaN/Inf steps before aborting to the elastic agent
+    max_consecutive_bad_steps: int = 3
+    retry: RetryConfig = Field(default_factory=RetryConfig)
+    checkpoint: ResilienceCheckpointConfig = Field(
+        default_factory=ResilienceCheckpointConfig)
+    # fault-injection table (see resilience/faults.py FaultSpec), e.g.
+    # [{"kind": "crash", "step": 3, "hard": true}]
+    faults: List[Dict[str, Any]] = Field(default_factory=list)
+
+
 class DeepSpeedTpuConfig(DSTpuConfigModel):
     """The root config. Accepts a dict or a JSON file path via :func:`from_config`."""
 
@@ -460,6 +497,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     moe: MoEConfig = Field(default_factory=MoEConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)

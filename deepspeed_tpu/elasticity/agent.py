@@ -20,6 +20,8 @@ inject failures deterministically.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -36,12 +38,16 @@ class Incarnation:
     global_batch: int
     exit_code: int
     duration_s: float
+    # snapshot of the trainer's resilience_report() after this cohort exited
+    # (None when the trainer died before writing one — e.g. a hard crash)
+    report: Optional[Dict] = None
 
 
 @dataclasses.dataclass
 class AgentResult:
     succeeded: bool
     history: List[Incarnation]
+    gave_up_reason: Optional[str] = None
 
     @property
     def restarts(self) -> int:
@@ -57,11 +63,57 @@ class ElasticAgent:
     admissible chip count; only micro-batch / grad-accum shift.
     """
 
-    def __init__(self, elastic_config: Dict, max_restarts: int = 3):
+    def __init__(self, elastic_config: Dict, max_restarts: int = 3,
+                 respawn_backoff_s: float = 0.0, max_backoff_s: float = 30.0,
+                 report_path: Optional[str] = None):
+        """``max_restarts`` caps TOTAL respawns (a deterministic crash — bad
+        config, poisoned data — must not hot-loop forever); between respawns
+        the agent backs off ``respawn_backoff_s * 2^restarts`` (capped at
+        ``max_backoff_s``). ``report_path`` names the trainer's
+        ``resilience_report.json``; when present the agent reads it after
+        every failed cohort and gives up early on failures the report shows
+        to be deterministic (a step-guard abort with no step progress since
+        the previous abort)."""
         self.cfg = dict(elastic_config)
         self.max_restarts = max_restarts
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.report_path = report_path
         self.global_batch, self.valid_chips, self.micro_map = \
             compute_elastic_config(self.cfg)
+
+    def _read_report(self) -> Optional[Dict]:
+        if not self.report_path or not os.path.exists(self.report_path):
+            return None
+        try:
+            with open(self.report_path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning(f"unreadable resilience report "
+                           f"{self.report_path}: {e}")
+            return None
+
+    @staticmethod
+    def _deterministic_failure(prev: Optional[Incarnation],
+                               cur: Incarnation) -> Optional[str]:
+        """Respawn-vs-give-up: a cohort that ABORTED through the step guard
+        (persistent NaN/Inf) and made no checkpoint progress since the last
+        aborted cohort will abort again — respawning burns the budget for
+        nothing. Hard crashes (no report) always get their respawn; the
+        restart cap bounds those."""
+        if cur.report is None or not cur.report.get("aborted"):
+            return None
+        if prev is None or prev.report is None or not prev.report.get("aborted"):
+            return None
+        prev_steps = prev.report.get("global_steps")
+        cur_steps = cur.report.get("global_steps")
+        if prev_steps is not None and cur_steps is not None \
+                and cur_steps <= prev_steps \
+                and cur.exit_code == prev.exit_code:
+            return (f"deterministic failure: two step-guard aborts at step "
+                    f"{cur_steps} with exit code {cur.exit_code} and no "
+                    "progress between them")
+        return None
 
     def next_world_size(self, current: int, lost: int = 1) -> Optional[int]:
         """Largest admissible chip count after losing ``lost`` chips
@@ -81,25 +133,52 @@ class ElasticAgent:
             raise ValueError(f"initial world size {chips} is not "
                              f"elastic-compatible (valid: {self.valid_chips})")
         history: List[Incarnation] = []
+        prev_failed: Optional[Incarnation] = None
         for attempt in range(self.max_restarts + 1):
             micro = self.micro_map[chips]
             log_dist(f"elastic agent: incarnation {attempt} chips={chips} "
                      f"micro={micro} global_batch={self.global_batch}")
+            if self.report_path and os.path.exists(self.report_path):
+                # a cohort that dies before writing must not inherit the
+                # previous cohort's report (stale aborts would trigger a
+                # wrongful deterministic-failure give-up)
+                try:
+                    os.unlink(self.report_path)
+                except OSError:
+                    pass
             t0 = time.time()
             rc = spawn(chips, micro, attempt)
-            history.append(Incarnation(chips, micro, self.global_batch, rc,
-                                       time.time() - t0))
+            inc = Incarnation(chips, micro, self.global_batch, rc,
+                              time.time() - t0, report=self._read_report())
+            history.append(inc)
+            logger.info(
+                f"elastic agent: incarnation {attempt} exited rc={rc} after "
+                f"{inc.duration_s:.1f}s (chips={chips}, steps="
+                f"{inc.report.get('global_steps') if inc.report else '?'})")
             if rc == 0:
                 return AgentResult(True, history)
+            reason = self._deterministic_failure(prev_failed, inc)
+            if reason is not None:
+                logger.error(f"elastic agent: giving up — {reason}")
+                return AgentResult(False, history, gave_up_reason=reason)
+            prev_failed = inc
             if attempt == self.max_restarts:
                 logger.error(f"elastic agent: cohort failed (rc={rc}) and the "
                              f"restart budget ({self.max_restarts}) is spent")
-                break
+                return AgentResult(False, history,
+                                   gave_up_reason="restart budget spent")
             nxt = self.next_world_size(chips, lost_per_failure)
             if nxt is None:
                 logger.error("elastic agent: no admissible world size below "
                              f"{chips}; giving up")
-                return AgentResult(False, history)
+                return AgentResult(False, history,
+                                   gave_up_reason="no admissible world size")
+            if self.respawn_backoff_s > 0:
+                delay = min(self.respawn_backoff_s * (2.0 ** attempt),
+                            self.max_backoff_s)
+                logger.warning(f"elastic agent: backing off {delay:.2f}s "
+                               "before respawn")
+                time.sleep(delay)
             logger.warning(f"elastic agent: cohort failed (rc={rc}); "
                            f"restarting at {nxt} chips (was {chips})")
             chips = nxt

@@ -1,0 +1,47 @@
+"""Resilience layer: fault injection, retries, preemption-safe checkpointing,
+and the self-healing step guard.
+
+The reference stack survives real-world failure through several loosely
+coupled mechanisms — the elastic agent respawns cohorts
+(``elasticity/elastic_agent.py``), the checkpoint engine commits atomically
+(``runtime/checkpoint_engine``), and the fp16 optimizers skip overflowed steps
+(``runtime/fp16/loss_scaler.py``). This package unifies those into one
+closed-loop subsystem for the TPU runtime, where preemption is routine and
+the unit of failure is a whole host:
+
+* :mod:`~deepspeed_tpu.resilience.faults` — deterministic fault injection
+  (crashes, hung collectives, torn checkpoint writes, NaN gradients) driven
+  by the ``resilience.faults`` config block or directly from tests;
+* :mod:`~deepspeed_tpu.resilience.retry` — :class:`RetryPolicy` (exponential
+  backoff + jitter + deadline) wrapped around checkpoint IO and the host-level
+  collective entry points in ``comm/comm.py``;
+* :mod:`~deepspeed_tpu.resilience.manager` — :class:`CheckpointManager`:
+  SIGTERM-triggered emergency save, keep-last-K retention, per-checkpoint
+  manifest + checksum, and load-time fallback to the previous verified tag;
+* :mod:`~deepspeed_tpu.resilience.guard` — :class:`StepGuard`: detects
+  NaN/Inf loss or gradients, skips the step, rewinds the LR/loss-scale tick,
+  and aborts to the elastic agent after N consecutive bad steps. All recovery
+  events are counted and exposed through ``resilience_report()``, which the
+  elastic agent consumes to decide respawn vs. give-up.
+"""
+
+from deepspeed_tpu.resilience.faults import (FaultInjector, InjectedCrash,
+                                             InjectedIOError, get_injector,
+                                             set_injector)
+from deepspeed_tpu.resilience.guard import StepGuard, TooManyBadSteps
+from deepspeed_tpu.resilience.manager import CheckpointManager
+from deepspeed_tpu.resilience.retry import RetryDeadlineExceeded, RetryPolicy, retry_call
+
+__all__ = [
+    "CheckpointManager",
+    "FaultInjector",
+    "InjectedCrash",
+    "InjectedIOError",
+    "RetryDeadlineExceeded",
+    "RetryPolicy",
+    "StepGuard",
+    "TooManyBadSteps",
+    "get_injector",
+    "set_injector",
+    "retry_call",
+]

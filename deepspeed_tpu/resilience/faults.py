@@ -1,0 +1,212 @@
+"""Deterministic fault injection.
+
+The reference validates its recovery paths with chaos-style integration tests
+(kill a rank mid-step, truncate a checkpoint shard); here the injection points
+are first-class so the SAME faults drive unit tests and the ``resilience``
+config block. A fault fires at an exact (site, step/occurrence) coordinate —
+never randomly — so every recovery test is reproducible.
+
+Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
+
+* ``"step"`` — start of the optimizer step; ``crash`` faults raise
+  :class:`InjectedCrash` (or hard-exit with ``exit_code`` when ``hard=True``,
+  simulating a host loss the Python runtime cannot catch).
+* ``"grads"`` — gradients about to be applied; ``nan_grads`` faults poison the
+  tree so the step guard's detection path is exercised end-to-end.
+* ``"collective"`` — host-level collective entry (``comm/comm.py``);
+  ``slow_collective`` sleeps, ``failed_collective`` raises
+  :class:`InjectedIOError` for the first ``times`` calls (retry testing).
+* ``"checkpoint_write"`` — checkpoint commit; ``torn_checkpoint`` truncates or
+  corrupts files after the save so verification must reject the tag.
+* ``"checkpoint_io"`` — checkpoint IO entry; ``io_error`` raises for the first
+  ``times`` calls (retry testing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["FaultSpec", "FaultInjector", "InjectedCrash", "InjectedIOError",
+           "get_injector", "set_injector"]
+
+
+class InjectedCrash(RuntimeError):
+    """A deliberate, injected process failure (soft crash)."""
+
+
+class InjectedIOError(OSError):
+    """A deliberate, injected IO/communication failure."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One configured fault.
+
+    ``kind``: crash | nan_grads | slow_collective | failed_collective |
+    torn_checkpoint | io_error.
+    ``step``: global step at which step-site faults fire (-1 = any step).
+    ``times``: for occurrence-counted faults (failed_collective / io_error),
+    how many consecutive calls fail before succeeding.
+    """
+
+    kind: str
+    step: int = -1
+    times: int = 1
+    hard: bool = False          # crash: os._exit instead of raising
+    exit_code: int = 43         # crash: hard-exit code
+    delay_s: float = 0.0        # slow_collective: injected latency
+    mode: str = "truncate"      # torn_checkpoint: truncate | corrupt | unlink
+
+    KINDS = ("crash", "nan_grads", "slow_collective", "failed_collective",
+             "torn_checkpoint", "io_error")
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(known: {list(self.KINDS)})")
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown fault spec keys {sorted(unknown)} "
+                             f"(known: {sorted(known)})")
+        return cls(**d)
+
+
+class FaultInjector:
+    """Holds the fault table and fires faults at hook sites.
+
+    Disabled (the default, empty table) it is a handful of dict lookups —
+    cheap enough that the hooks stay unconditionally wired.
+    """
+
+    def __init__(self, faults: Optional[List] = None):
+        self.faults: List[FaultSpec] = [
+            f if isinstance(f, FaultSpec) else FaultSpec.from_dict(dict(f))
+            for f in (faults or [])]
+        self.fired: List[str] = []          # audit log of faults that fired
+        self._counts: Dict[int, int] = {}   # per-spec occurrence counter
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def _record(self, spec: FaultSpec, site: str) -> None:
+        self.fired.append(f"{spec.kind}@{site}:step={spec.step}")
+        logger.warning(f"fault injected: {spec.kind} at {site} "
+                       f"(step={spec.step})")
+
+    def _take(self, spec: FaultSpec) -> bool:
+        """Occurrence-counted firing: True for the first ``times`` calls."""
+        i = id(spec)
+        n = self._counts.get(i, 0)
+        if n >= spec.times:
+            return False
+        self._counts[i] = n + 1
+        return True
+
+    # ---- step-site faults -------------------------------------------------
+    def maybe_crash(self, step: int) -> None:
+        for spec in self.faults:
+            if spec.kind == "crash" and spec.step in (step, -1) \
+                    and self._take(spec):
+                self._record(spec, "step")
+                if spec.hard:
+                    os._exit(spec.exit_code)
+                raise InjectedCrash(f"injected crash at step {step}")
+
+    def maybe_poison_grads(self, step: int, grads):
+        """Return ``grads`` with NaNs injected if a nan_grads fault matches."""
+        for spec in self.faults:
+            if spec.kind == "nan_grads" and spec.step in (step, -1) \
+                    and self._take(spec):
+                self._record(spec, "grads")
+                import jax
+                import jax.numpy as jnp
+
+                return jax.tree_util.tree_map(
+                    lambda g: jnp.full_like(g, jnp.nan), grads)
+        return grads
+
+    # ---- collective-site faults -------------------------------------------
+    def on_collective(self, name: str) -> None:
+        for spec in self.faults:
+            if spec.kind == "slow_collective" and self._take(spec):
+                self._record(spec, f"collective:{name}")
+                time.sleep(spec.delay_s)
+            elif spec.kind == "failed_collective" and self._take(spec):
+                self._record(spec, f"collective:{name}")
+                raise InjectedIOError(f"injected collective failure in {name}")
+
+    # ---- checkpoint-site faults -------------------------------------------
+    def on_checkpoint_io(self, what: str) -> None:
+        for spec in self.faults:
+            if spec.kind == "io_error" and self._take(spec):
+                self._record(spec, f"checkpoint_io:{what}")
+                raise InjectedIOError(f"injected checkpoint IO failure ({what})")
+
+    def maybe_tear_checkpoint(self, tag_dir: str, step: int) -> bool:
+        """After a save: damage the newest tag so verification must reject it.
+        Returns True if a tear fired (callers may want to log)."""
+        fired = False
+        for spec in self.faults:
+            if spec.kind == "torn_checkpoint" and spec.step in (step, -1) \
+                    and self._take(spec):
+                self._record(spec, "checkpoint_write")
+                tear_checkpoint_dir(tag_dir, mode=spec.mode)
+                fired = True
+        return fired
+
+
+def tear_checkpoint_dir(tag_dir: str, mode: str = "truncate") -> None:
+    """Damage a checkpoint tag directory in-place (also callable from tests).
+
+    ``truncate`` halves the largest data file (a torn write), ``corrupt``
+    flips bytes in it (silent bit rot), ``unlink`` removes it (lost object).
+    """
+    victims = []
+    for root, _dirs, files in os.walk(tag_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            victims.append((os.path.getsize(p), p))
+    if not victims:
+        raise FileNotFoundError(f"no files to tear under {tag_dir}")
+    _, victim = max(victims)
+    if mode == "truncate":
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "corrupt":
+        with open(victim, "r+b") as f:
+            data = bytearray(f.read())
+            for i in range(0, len(data), max(len(data) // 64, 1)):
+                data[i] ^= 0xFF
+            f.seek(0)
+            f.write(data)
+    elif mode == "unlink":
+        os.unlink(victim)
+    else:
+        raise ValueError(f"unknown tear mode {mode!r}")
+    logger.warning(f"tore checkpoint file {victim} (mode={mode})")
+
+
+# The process-wide injector: hooks in engine/comm/checkpoint consult this.
+# Tests and the config plumbing swap it; the default empty injector is inert.
+_injector = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    return _injector
+
+
+def set_injector(inj: Optional[FaultInjector]) -> FaultInjector:
+    """Install ``inj`` (or a fresh inert injector when None); returns it."""
+    global _injector
+    _injector = inj if inj is not None else FaultInjector()
+    return _injector

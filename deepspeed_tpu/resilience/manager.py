@@ -1,0 +1,318 @@
+"""Preemption-aware checkpoint lifecycle management.
+
+Layered over ``runtime/checkpoint.py`` (which owns the orbax data format):
+
+* every save writes a per-tag ``manifest.json`` (file sizes + sha256) BEFORE
+  the ``latest`` pointer moves, so ``latest`` only ever names a tag whose
+  integrity can be proven;
+* loads verify the manifest and step back to the previous good tag when the
+  newest one fails (torn write, lost object, bit rot) instead of crashing;
+* keep-last-K retention garbage-collects old tags (never the one ``latest``
+  points at);
+* a SIGTERM handler arms an emergency save that fires at the next step
+  boundary — the TPU preemption notice → drain → save → exit flow;
+* all IO goes through :func:`~deepspeed_tpu.resilience.retry.retry_call`.
+
+Every recovery event is counted in :attr:`CheckpointManager.counters`, which
+``engine.resilience_report()`` folds into the report the elastic agent reads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import signal
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.resilience.faults import get_injector
+from deepspeed_tpu.resilience.retry import RetryPolicy, retry_call
+from deepspeed_tpu.utils.io import atomic_write_text
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+MANIFEST_FILE = "manifest.json"
+
+__all__ = ["CheckpointManager", "verify_tag_dir", "write_manifest"]
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _walk_files(tag_dir: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(tag_dir):
+        for f in files:
+            if f == MANIFEST_FILE and root == tag_dir:
+                continue
+            out.append(os.path.relpath(os.path.join(root, f), tag_dir))
+    return sorted(out)
+
+
+def write_manifest(tag_dir: str, global_steps: int) -> str:
+    """Checksum every file under ``tag_dir`` into ``manifest.json``."""
+    files = {}
+    for rel in _walk_files(tag_dir):
+        p = os.path.join(tag_dir, rel)
+        files[rel] = {"size": os.path.getsize(p), "sha256": _sha256(p)}
+    manifest = {"tag": os.path.basename(tag_dir),
+                "global_steps": int(global_steps),
+                "created": time.time(),
+                "files": files}
+    path = os.path.join(tag_dir, MANIFEST_FILE)
+    atomic_write_text(path, json.dumps(manifest, indent=2))
+    return path
+
+
+def verify_tag_dir(tag_dir: str) -> Tuple[bool, str]:
+    """Check ``tag_dir`` against its manifest. Returns (ok, reason)."""
+    mpath = os.path.join(tag_dir, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return False, "no manifest"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, want in manifest.get("files", {}).items():
+        p = os.path.join(tag_dir, rel)
+        if not os.path.exists(p):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(p)
+        if size != want["size"]:
+            return False, f"size mismatch {rel}: {size} != {want['size']}"
+        if _sha256(p) != want["sha256"]:
+            return False, f"checksum mismatch {rel}"
+    return True, "ok"
+
+
+class CheckpointManager:
+    """One manager per checkpoint directory. See module docstring."""
+
+    def __init__(self, save_dir: str, keep_last_k: int = 3,
+                 verify: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None):
+        self.save_dir = os.path.abspath(save_dir)
+        self.keep_last_k = int(keep_last_k)
+        self.verify = bool(verify)
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.preempted = False
+        self._preempt_handler_installed = False
+        self._prev_sigterm = None
+        self.counters: Dict[str, int] = {
+            "saves": 0, "emergency_saves": 0, "gc_removed": 0,
+            "verify_failures": 0, "load_fallbacks": 0, "io_retries": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save(self, engine, tag: Optional[str] = None,
+             client_state: Optional[Dict] = None,
+             emergency: bool = False) -> str:
+        """Commit protocol: data → manifest → atomic ``latest`` → GC.
+
+        A crash at ANY point leaves either the previous checkpoint resumable
+        (latest untouched) or the new one fully verified."""
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+
+        tag = tag or f"global_step{engine.global_steps}"
+        inj = get_injector()
+
+        def _on_retry(_attempt, _exc):
+            self.counters["io_retries"] += 1
+
+        def _save():
+            inj.on_checkpoint_io("save")
+            path = ckpt.save_checkpoint(engine, self.save_dir, tag=tag,
+                                        client_state=client_state,
+                                        write_latest=False)
+            ckpt.finalize_pending(engine)  # manifest must see committed bytes
+            return path
+
+        path = retry_call(_save, policy=self.retry_policy,
+                          what=f"checkpoint save ({tag})", on_retry=_on_retry)
+        import jax
+
+        if jax.process_index() == 0:
+            write_manifest(path, engine.global_steps)
+            # a configured torn_checkpoint fault damages the tag here — after
+            # the manifest, before latest — modeling a torn write that the
+            # load-time verification must catch
+            inj.maybe_tear_checkpoint(path, engine.global_steps)
+            ckpt.write_latest_atomic(self.save_dir, tag)
+            self._gc()
+        self.counters["emergency_saves" if emergency else "saves"] += 1
+        log_dist(f"checkpoint committed: {path} (emergency={emergency})")
+        return path
+
+    # ------------------------------------------------------------------
+    # load with fallback
+    # ------------------------------------------------------------------
+    def _tags_newest_first(self) -> List[str]:
+        """Checkpoint tag dirs under save_dir, newest first (manifest step,
+        then mtime), with the ``latest`` pointee promoted to the front.
+
+        Only directories that LOOK like checkpoints (a manifest, an engine
+        ``meta.json``, or an orbax ``state`` tree) are considered — the
+        checkpoint dir routinely hosts unrelated subdirectories (monitor
+        logs, tensorboard) that GC must never touch."""
+        from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+        entries = []
+        if os.path.isdir(self.save_dir):
+            for name in os.listdir(self.save_dir):
+                d = os.path.join(self.save_dir, name)
+                if not os.path.isdir(d):
+                    continue
+                mpath = os.path.join(d, MANIFEST_FILE)
+                if not os.path.exists(mpath) \
+                        and not os.path.exists(os.path.join(d, "meta.json")) \
+                        and not os.path.isdir(os.path.join(d, "state")):
+                    continue
+                step = -1
+                if os.path.exists(mpath):
+                    try:
+                        with open(mpath) as f:
+                            step = int(json.load(f).get("global_steps", -1))
+                    except (OSError, ValueError):
+                        pass
+                entries.append((step, os.path.getmtime(d), name))
+        entries.sort(reverse=True)
+        tags = [name for _s, _m, name in entries]
+        latest = read_latest_tag(self.save_dir)
+        if latest in tags:
+            tags.remove(latest)
+            tags.insert(0, latest)
+        return tags
+
+    def load(self, engine, tag: Optional[str] = None,
+             load_optimizer_states: bool = True):
+        """Restore the newest VERIFIED checkpoint; fall back tag-by-tag.
+
+        With an explicit ``tag`` only that tag is tried (verification still
+        applies). Returns ``(path, client_state)`` like ``load_checkpoint``,
+        or ``(None, {})`` when nothing loadable exists."""
+        from deepspeed_tpu.runtime import checkpoint as ckpt
+
+        candidates = [tag] if tag is not None else self._tags_newest_first()
+        if not candidates:
+            logger.warning(f"no checkpoints under {self.save_dir}")
+            return None, {}
+        inj = get_injector()
+        wanted = candidates[0]
+        last_err: Optional[str] = None
+        for cand in candidates:
+            tag_dir = os.path.join(self.save_dir, cand)
+            if self.verify:
+                if not os.path.exists(os.path.join(tag_dir, MANIFEST_FILE)):
+                    # legacy tag saved before resilience was enabled: there
+                    # is nothing to checksum, but rejecting a perfectly good
+                    # checkpoint would strand the run — load unverified
+                    logger.warning(f"checkpoint {cand} predates manifest "
+                                   "verification; loading unverified")
+                else:
+                    ok, why = verify_tag_dir(tag_dir)
+                    if not ok:
+                        self.counters["verify_failures"] += 1
+                        logger.error(f"checkpoint {cand} failed verification "
+                                     f"({why}); stepping back")
+                        last_err = f"{cand}: {why}"
+                        continue
+
+            def _on_retry(_attempt, _exc):
+                self.counters["io_retries"] += 1
+
+            def _load(c=cand):
+                inj.on_checkpoint_io("load")
+                return ckpt.load_checkpoint(
+                    engine, self.save_dir, tag=c,
+                    load_optimizer_states=load_optimizer_states)
+            try:
+                path, client = retry_call(_load, policy=self.retry_policy,
+                                          what=f"checkpoint load ({cand})",
+                                          on_retry=_on_retry)
+            except Exception as e:  # torn beyond what checksums cover
+                self.counters["verify_failures"] += 1
+                logger.error(f"checkpoint {cand} failed to restore ({e}); "
+                             "stepping back")
+                last_err = f"{cand}: {e}"
+                continue
+            if cand != wanted:
+                self.counters["load_fallbacks"] += 1
+                import jax
+
+                if jax.process_index() == 0:
+                    ckpt.write_latest_atomic(self.save_dir, cand)
+                logger.warning(f"recovered from fallback checkpoint {cand} "
+                               f"(wanted {wanted})")
+            return path, client
+        raise RuntimeError(
+            f"no verifiable checkpoint under {self.save_dir} "
+            f"(tried {candidates}; last error: {last_err})")
+
+    # ------------------------------------------------------------------
+    # retention
+    # ------------------------------------------------------------------
+    def _gc(self) -> None:
+        if self.keep_last_k <= 0:
+            return
+        from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+        tags = self._tags_newest_first()
+        latest = read_latest_tag(self.save_dir)
+        for old in tags[self.keep_last_k:]:
+            if old == latest:
+                continue
+            shutil.rmtree(os.path.join(self.save_dir, old),
+                          ignore_errors=True)
+            self.counters["gc_removed"] += 1
+            log_dist(f"checkpoint GC: removed {old}")
+
+    # ------------------------------------------------------------------
+    # preemption
+    # ------------------------------------------------------------------
+    def install_preemption_handler(self) -> None:
+        """Arm SIGTERM → emergency-save-at-next-boundary (idempotent).
+
+        The handler only sets a flag: the actual save runs at a step boundary
+        (``engine._commit_step``) where params/optimizer state are a complete,
+        consistent tree — never mid-dispatch."""
+        if self._preempt_handler_installed:
+            return
+
+        def _handler(signum, frame):
+            self.preempted = True
+            logger.warning("SIGTERM received: emergency checkpoint armed "
+                           "for the next step boundary")
+            if callable(self._prev_sigterm):
+                self._prev_sigterm(signum, frame)
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+            self._preempt_handler_installed = True
+        except ValueError:
+            # not the main thread (e.g. a test runner worker): preemption
+            # saves can still be triggered via maybe_emergency_save
+            logger.warning("cannot install SIGTERM handler outside the main "
+                           "thread; emergency saves must be triggered "
+                           "manually")
+
+    def maybe_emergency_save(self, engine) -> Optional[str]:
+        """Called at step boundaries: save once if a preemption is pending."""
+        if not self.preempted:
+            return None
+        self.preempted = False
+        tag = f"preempt_step{engine.global_steps}"
+        path = self.save(engine, tag=tag, emergency=True)
+        logger.warning(f"emergency checkpoint saved to {path}")
+        return path

@@ -40,6 +40,16 @@ def _tag_dir(save_dir: str, tag: str) -> str:
     return os.path.abspath(os.path.join(save_dir, tag))
 
 
+def write_latest_atomic(save_dir: str, tag: str) -> None:
+    """Atomically point ``latest`` at ``tag``: a crash mid-write can never
+    leave a truncated/empty pointer, so readers see either the old committed
+    tag or the new one."""
+    from deepspeed_tpu.utils.io import atomic_write_text
+
+    atomic_write_text(os.path.join(os.path.abspath(save_dir), LATEST_FILE),
+                      tag)
+
+
 def finalize_pending(engine) -> None:
     """Block until an in-flight async save commits (and its ``latest`` is written).
 
@@ -60,13 +70,16 @@ def finalize_pending(engine) -> None:
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[Dict] = None) -> str:
+                    client_state: Optional[Dict] = None,
+                    write_latest: bool = True) -> str:
     """Write a tagged sharded checkpoint + ``latest`` pointer.
 
     ``latest`` is written only after the data is durably committed — immediately
     for sync saves, from a commit thread after ``wait_until_finished`` for async
     saves — and any prior in-flight async save is finalized first so IO errors
-    are never silently dropped.
+    are never silently dropped. ``write_latest=False`` leaves the pointer to a
+    caller that interposes its own commit step (the resilience
+    ``CheckpointManager`` writes a manifest first, then moves ``latest``).
     """
     import threading
 
@@ -102,9 +115,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             json.dump(meta, f, indent=2, default=str)
 
     def _write_latest():
-        if jax.process_index() == 0:
-            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
-                f.write(tag)
+        if write_latest and jax.process_index() == 0:
+            write_latest_atomic(save_dir, tag)
 
     if async_save:
         import atexit

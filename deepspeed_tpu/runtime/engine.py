@@ -262,6 +262,27 @@ class DeepSpeedTpuEngine:
                         "layers receive no gradients but decoupled decay "
                         "would keep shrinking them every step")
 
+        # ---- resilience (step guard, retries, fault injection) ---------
+        rcfg = config.resilience
+        self._guard = None
+        self._ckpt_managers: Dict[str, Any] = {}
+        self._primary_mgr = None
+        self._resilience_report_dir = os.environ.get("DSTPU_CHECKPOINT_DIR")
+        if rcfg.enabled:
+            from deepspeed_tpu import comm as comm_mod
+            from deepspeed_tpu.resilience import (FaultInjector, RetryPolicy,
+                                                  StepGuard, set_injector)
+
+            if rcfg.faults:
+                set_injector(FaultInjector(rcfg.faults))
+            self._guard = StepGuard(
+                self, max_consecutive_bad_steps=rcfg.max_consecutive_bad_steps)
+            comm_mod.set_retry_policy(RetryPolicy(**rcfg.retry.model_dump()))
+            if self._resilience_report_dir:
+                # launched under the elastic agent: arm the preemption
+                # handler against the agent's checkpoint dir right away
+                self._resilience_manager(self._resilience_report_dir)
+
         self.training_dataloader = None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data,
@@ -675,6 +696,10 @@ class DeepSpeedTpuEngine:
         """Optimizer step at the GA boundary — engine.py:3241."""
         if not self.is_gradient_accumulation_boundary():
             return
+        # self-healing guard: fires configured faults, then skips (instead of
+        # applying) a step whose loss/grads are non-finite
+        if self._guard is not None and self._guard.intercept():
+            return
         if self._offload is not None:
             ga = float(self.config.gradient_accumulation_steps)
             denom = ga * float(self.scaler_state["scale"])  # unscale fp16 loss scale
@@ -770,6 +795,16 @@ class DeepSpeedTpuEngine:
                 ("Train/Samples/train_loss", float(self._last_loss), self.global_samples),
                 ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
             ])
+        if self._primary_mgr is not None and self._primary_mgr.preempted:
+            # the step boundary is the consistent point: params/opt state are
+            # complete trees — but an overlapped host-offload step may still
+            # be in flight; drain it so the snapshot matches global_steps
+            if self._offload is not None and self._offload.overlap:
+                self._collect_offload()
+            self._primary_mgr.maybe_emergency_save(self)
+            rc = self.config.resilience.checkpoint
+            if rc.exit_on_preempt:
+                raise SystemExit(rc.preempt_exit_code)
 
     def train_batch(self, data_iter: Optional[Iterable] = None):
         """One full global batch = GA micro-steps + optimizer step
@@ -810,12 +845,14 @@ class DeepSpeedTpuEngine:
             self._update_random_ltd()
         batch = self._apply_curriculum(batch)
         batch = self._inject_ltd_seed(batch)
+        if self._guard is not None:
+            self._guard.pre_step()  # crash faults fire on the fused path too
         if self._offload is not None:
-            return self._fused_offload_step(batch, ga)
+            return self._guarded_loss(self._fused_offload_step(batch, ga))
         if self._onebit is not None:
-            return self._fused_onebit_step(batch, ga)
+            return self._guarded_loss(self._fused_onebit_step(batch, ga))
         if self._zpp is not None:
-            return self._fused_zpp_step(batch, ga)
+            return self._guarded_loss(self._fused_zpp_step(batch, ga))
         key = ga
         if key not in self._fused_step_cache:
             def fused(params, opt_state, batch, scaler):
@@ -837,6 +874,15 @@ class DeepSpeedTpuEngine:
         # only fp16 can skip; reading `skipped` otherwise would force a host
         # sync per step and serialize the dispatch pipeline
         self._commit_step(self.fp16_enabled and bool(skipped))
+        return self._guarded_loss(loss)
+
+    def _guarded_loss(self, loss):
+        """Post-hoc health check for fused paths: the update already ran in
+        one jit, so a bad step is detected (and escalated past the budget)
+        rather than unwound — use the imperative path or fp16's in-jit skip
+        when per-step skipping matters."""
+        if self._guard is not None:
+            self._guard.check_loss(loss)
         return loss
 
     def _fused_onebit_step(self, batch, ga: int):
@@ -985,6 +1031,10 @@ class DeepSpeedTpuEngine:
 
         if self._offload is not None and self._offload.overlap:
             self._collect_offload()  # drain the async step before snapshotting
+        if self._resilience_enabled():
+            self._resilience_manager(save_dir).save(
+                self, tag=tag, client_state=client_state or {})
+            return
         save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -993,7 +1043,76 @@ class DeepSpeedTpuEngine:
 
         if self._offload is not None and self._offload.overlap:
             self._collect_offload()
-        out = load_checkpoint(self, load_dir, tag=tag,
-                              load_optimizer_states=load_optimizer_states)
+        if self._resilience_enabled():
+            out = self._resilience_manager(load_dir).load(
+                self, tag=tag, load_optimizer_states=load_optimizer_states)
+        else:
+            out = load_checkpoint(self, load_dir, tag=tag,
+                                  load_optimizer_states=load_optimizer_states)
         self._refresh_hpz()  # secondary copy is derived state, not checkpointed
         return out
+
+    # ------------------------------------------------------------------
+    # resilience surface
+    # ------------------------------------------------------------------
+    def _resilience_enabled(self) -> bool:
+        return bool(self.config.resilience.enabled)
+
+    def _resilience_manager(self, ckpt_dir: str):
+        """One CheckpointManager per checkpoint directory; the first becomes
+        the preemption-save target."""
+        from deepspeed_tpu.resilience import CheckpointManager, RetryPolicy
+
+        key = os.path.abspath(ckpt_dir)
+        mgr = self._ckpt_managers.get(key)
+        if mgr is None:
+            rc = self.config.resilience
+            mgr = CheckpointManager(
+                ckpt_dir, keep_last_k=rc.checkpoint.keep_last_k,
+                verify=rc.checkpoint.verify,
+                retry_policy=RetryPolicy(**rc.retry.model_dump()))
+            if rc.checkpoint.save_on_preempt:
+                mgr.install_preemption_handler()
+            self._ckpt_managers[key] = mgr
+            if self._primary_mgr is None:
+                self._primary_mgr = mgr
+            if not self._resilience_report_dir:
+                self._resilience_report_dir = key
+        return mgr
+
+    def resilience_report(self) -> Dict[str, Any]:
+        """Recovery-event counters for the elastic agent's respawn-vs-give-up
+        decision (and for operators): step-guard skips/aborts, checkpoint
+        verification failures/fallbacks/GC, comm retries, faults fired."""
+        from deepspeed_tpu import comm as comm_mod
+        from deepspeed_tpu.resilience.faults import get_injector
+
+        ckpt: Dict[str, int] = {}
+        for mgr in self._ckpt_managers.values():
+            for k, v in mgr.counters.items():
+                ckpt[k] = ckpt.get(k, 0) + v
+        guard = self._guard
+        return {
+            "schema": 1,
+            "global_steps": self.global_steps,
+            "skipped_steps": self.skipped_steps,
+            "guard": dict(guard.counters) if guard is not None else {},
+            "consecutive_bad_steps": (guard.consecutive_bad
+                                      if guard is not None else 0),
+            "aborted": bool(guard.counters["aborts"]) if guard else False,
+            "checkpoint": ckpt,
+            "comm": comm_mod.get_retry_stats(),
+            "faults_fired": list(get_injector().fired),
+        }
+
+    def write_resilience_report(self, out_dir: str) -> str:
+        """Atomically persist ``resilience_report()`` where the elastic agent
+        looks for it (the checkpoint dir)."""
+        import json
+
+        from deepspeed_tpu.utils.io import atomic_write_text
+
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "resilience_report.json")
+        atomic_write_text(path, json.dumps(self.resilience_report(), indent=2))
+        return path

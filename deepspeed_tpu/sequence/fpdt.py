@@ -112,6 +112,11 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return get_attention_impl("auto")(q, k, v, causal=causal)
     if offload is None:
         offload = _supports_host_memory()
+    elif offload and not _supports_host_memory():
+        # explicit offload=True on a backend with no pinned_host memory
+        # space (e.g. older jax CPU): the host tier cannot exist — degrade
+        # to chunked-recurrence mode, which still bounds the working set
+        offload = False
     mesh = jax.sharding.get_abstract_mesh()
     if offload and mesh is not None and not mesh.empty \
             and math.prod(mesh.shape.values()) > 1:

@@ -1,0 +1,164 @@
+"""Compatibility shims for older JAX releases (0.4.x).
+
+The codebase targets the current stable JAX surface (``jax.shard_map``,
+``jax.sharding.set_mesh`` / ``get_abstract_mesh``). On 0.4.x those live in
+``jax.experimental.shard_map`` / the ``with mesh:`` resource context with
+slightly different spellings:
+
+* ``jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names=, check_vma=)``
+  → ``jax.experimental.shard_map.shard_map`` with ``auto`` = (mesh axes −
+  ``axis_names``) and ``check_rep`` in place of ``check_vma``; a missing
+  ``mesh`` falls back to the ambient resource-context mesh;
+* ``jax.sharding.set_mesh(mesh)`` → the ``with mesh:`` physical-mesh context;
+* ``jax.sharding.get_abstract_mesh()`` → the ambient physical mesh (callers
+  only touch ``.empty`` / ``.axis_names`` / ``.shape``, which concrete
+  ``Mesh`` provides).
+
+:func:`install` patches the missing names onto ``jax`` once, at package
+import, and is a no-op on releases that already provide them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["install"]
+
+
+# Stack of the *intended* manual-axis sets of live compat shard_map regions
+# (the axes the caller named via ``axis_names``). 0.4.x lowers every region
+# to fully-manual, so the axis env alone cannot distinguish "manual because
+# the caller asked" from "manual because the shim had no partial mode".
+_manual_intent: list = []
+
+
+class _MeshView:
+    """Ambient-mesh proxy adding the newer-jax ``manual_axes`` attribute.
+
+    ``manual_axes``: axes the enclosing shard_map callers INTENDED as manual.
+    ``compat_replicated_axes``: axes bound manual only by the full-manual
+    lowering — their data is replicated, not sharded, inside the region.
+    """
+
+    def __init__(self, mesh, manual, bound):
+        self._mesh = mesh
+        self.manual_axes = frozenset(manual)
+        self.compat_replicated_axes = frozenset(bound) - frozenset(manual)
+
+    def __getattr__(self, name):
+        return getattr(self._mesh, name)
+
+
+def _ambient_mesh():
+    import jax._src.core as _core
+    from jax._src.mesh import thread_resources
+
+    m = thread_resources.env.physical_mesh
+    if m.empty:
+        return None
+    bound = set(_core.get_axis_env().axis_sizes)
+    if not bound:
+        return m
+    manual = set().union(*_manual_intent) if _manual_intent else set(bound)
+    return _MeshView(m, manual & bound, bound)
+
+
+def _compat_shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, check_vma=None, check_rep=None,
+                      auto=None):
+    del check_vma, check_rep
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if mesh is None:
+        mesh = _ambient_mesh()
+        if mesh is None:
+            raise ValueError(
+                "shard_map: no mesh passed and no ambient mesh set "
+                "(enter jax.sharding.set_mesh(mesh) first)")
+    if isinstance(mesh, _MeshView):
+        mesh = mesh._mesh
+    # Partial-manual regions (`axis_names` ⊂ mesh axes, rest auto) hard-abort
+    # 0.4.x's SPMD partitioner (spmd_partitioner.cc IsManualSubgroup check),
+    # taking the whole process down. Lower to a FULLY manual region instead:
+    # spec-unmentioned mesh axes become replicated rather than auto-sharded —
+    # numerically identical, redundant compute along the former auto axes.
+    # Acceptable for the CPU dev environment; real pods run a jax with native
+    # jax.shard_map, where this shim never installs. The caller's intended
+    # manual set is recorded so get_abstract_mesh() can still report which
+    # axes are semantically manual vs merely compat-replicated.
+    if axis_names is not None:
+        intent = frozenset(axis_names)
+    elif auto is not None:
+        intent = frozenset(mesh.axis_names) - frozenset(auto)
+    else:
+        intent = frozenset(mesh.axis_names)
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def body(*args, **kw):
+            _manual_intent.append(intent)
+            try:
+                return fn(*args, **kw)
+            finally:
+                _manual_intent.pop()
+
+        return _shard_map(body, **kwargs)
+
+    if f is None:
+        return wrap
+    return wrap(f)
+
+
+@contextlib.contextmanager
+def _compat_set_mesh(mesh):
+    with mesh:
+        yield mesh
+
+
+def _compat_axis_size(axis_name) -> int:
+    import jax._src.core as _core
+
+    if isinstance(axis_name, (tuple, list)):
+        import math
+
+        return math.prod(_core.axis_frame(a) for a in axis_name)
+    return _core.axis_frame(axis_name)
+
+
+def install() -> None:
+    from jax import lax
+
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _compat_shard_map
+        # The full-manual lowering above breaks sharding constraints inside
+        # shard_map bodies (every mesh axis is manual there, and 0.4.x
+        # rejects constraints naming manual axes). Constraints are layout
+        # hints for the auto partitioner — under full manual there is
+        # nothing left to hint, so drop them inside bound-axis regions.
+        _orig_wsc = lax.with_sharding_constraint
+
+        def _compat_wsc(x, shardings, *args, **kwargs):
+            import jax._src.core as _core
+
+            if _core.nonempty_axis_env():
+                return x
+            return _orig_wsc(x, shardings, *args, **kwargs)
+
+        lax.with_sharding_constraint = _compat_wsc
+    if not hasattr(jax.sharding, "set_mesh"):
+        jax.sharding.set_mesh = _compat_set_mesh
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _ambient_mesh
+    if not hasattr(lax, "axis_size"):
+        # 0.4.x keeps the static bound-axis size in the core axis env
+        lax.axis_size = _compat_axis_size
+    if not hasattr(lax, "pvary"):
+        # pvary only exists for the VMA (varying-manual-axes) checker, which
+        # 0.4.x lacks — with check_rep=False it is semantically an identity
+        lax.pvary = lambda x, axis_name: x

@@ -204,6 +204,8 @@ class Test1F1B:
                     rtol=5e-2, atol=5e-4,
                     err_msg=f"save={save} {jax.tree_util.keystr(k)}")
 
+    @pytest.mark.slow  # ~2 min of compiles; the peak-memory ratio it pins
+    # down is XLA-cost-model sensitive (borderline on older CPU backends)
     def test_1f1b_memory_flat_in_microbatches(self, eight_devices):
         """GPipe's live state grows with M (stacked outputs + all saved
         stage inputs); 1F1B's rolling buffer is bounded by the stage count.
