@@ -44,6 +44,20 @@ SUM, AVG, MAX, MIN, PROD = "sum", "avg", "max", "min", "prod"
 # they get the RetryPolicy treatment when the resilience layer arms one.
 _retry_policy = None
 _retry_stats = {"retries": 0}
+# (name, monotonic start) of the host collective currently executing, if any —
+# the hang watchdog reads this to tell "stuck in a collective" from "stalled
+# between steps". Single-slot: host collectives are serialized per process.
+_inflight: Optional[tuple] = None
+
+
+def get_inflight() -> Optional[dict]:
+    """The in-flight host collective as ``{"name", "elapsed_s"}``, or None."""
+    snap = _inflight
+    if snap is None:
+        return None
+    import time
+
+    return {"name": snap[0], "elapsed_s": time.monotonic() - snap[1]}
 
 
 def _retryable_exceptions() -> tuple:
@@ -78,11 +92,18 @@ def get_retry_stats() -> dict:
 def _resilient(name: str, fn, *args, **kwargs):
     """Run a host collective through the fault-injection hook and, when a
     policy is armed, the retry loop. Inert (two attribute loads) otherwise."""
+    import time
+
     from deepspeed_tpu.resilience.faults import get_injector
 
     def call():
-        get_injector().on_collective(name)
-        return fn(*args, **kwargs)
+        global _inflight
+        _inflight = (name, time.monotonic())
+        try:
+            get_injector().on_collective(name)
+            return fn(*args, **kwargs)
+        finally:
+            _inflight = None
 
     if _retry_policy is None:
         return call()

@@ -453,12 +453,51 @@ class ResilienceCheckpointConfig(DSTpuConfigModel):
     save_on_preempt: bool = True  # SIGTERM → emergency save at next boundary
     exit_on_preempt: bool = False
     preempt_exit_code: int = 42
+    # stage inline, commit (manifest → latest → GC) on a background thread;
+    # a .staging sentinel keeps crash-in-the-window tags load-rejectable
+    async_save: bool = False
+
+
+class CoordinationConfig(DSTpuConfigModel):
+    """``resilience.coordination``: fleet-agreed SAVE/ABORT decisions.
+
+    At each step boundary every process folds its local signals (preemption
+    notice, step-guard budget, watchdog hang) into one tiny host max-reduce,
+    so no process commits ``latest`` or exits to the elastic agent
+    unilaterally. The reduce is a blocking cross-host round trip: at
+    ``interval_steps=1`` (the default, matching the decision-latency
+    guarantee) every boundary pays it, which can tax very short steps on
+    large fleets — raise ``interval_steps`` there; signals are held across
+    off-interval boundaries, never dropped."""
+
+    enabled: bool = True
+    interval_steps: int = 1
+
+
+class HeartbeatConfig(DSTpuConfigModel):
+    """``resilience.heartbeat``: per-process liveness files + hang watchdog.
+
+    ``dir`` defaults to ``<checkpoint dir>/heartbeats``. A host collective in
+    flight longer than ``collective_deadline_s``, or no step boundary for
+    ``deadline_s``, escalates per ``on_hang``: ``abort`` (coordinated ABORT
+    at the next boundary — the default), ``exit`` (``os._exit(exit_code)``,
+    the only way out of a hard wedge), or ``report`` (count + log only)."""
+
+    enabled: bool = False
+    dir: Optional[str] = None
+    interval_s: float = 5.0
+    deadline_s: float = 300.0
+    collective_deadline_s: Optional[float] = 120.0
+    poll_s: Optional[float] = None   # default: min(deadlines) / 4
+    on_hang: str = "abort"
+    exit_code: int = 47
 
 
 class ResilienceConfig(DSTpuConfigModel):
     """``resilience`` section: the closed-loop fault-tolerance layer
     (``deepspeed_tpu/resilience``) — step guard, retries, checkpoint
-    verification/fallback, and deterministic fault injection for drills."""
+    verification/fallback, multi-host decision coordination, heartbeat/hang
+    watchdog, and deterministic fault injection for drills."""
 
     enabled: bool = False
     # consecutive NaN/Inf steps before aborting to the elastic agent
@@ -466,6 +505,9 @@ class ResilienceConfig(DSTpuConfigModel):
     retry: RetryConfig = Field(default_factory=RetryConfig)
     checkpoint: ResilienceCheckpointConfig = Field(
         default_factory=ResilienceCheckpointConfig)
+    coordination: CoordinationConfig = Field(
+        default_factory=CoordinationConfig)
+    heartbeat: HeartbeatConfig = Field(default_factory=HeartbeatConfig)
     # fault-injection table (see resilience/faults.py FaultSpec), e.g.
     # [{"kind": "crash", "step": 3, "hard": true}]
     faults: List[Dict[str, Any]] = Field(default_factory=list)

@@ -103,6 +103,18 @@ class ElasticAgent:
         restart cap bounds those."""
         if cur.report is None or not cur.report.get("aborted"):
             return None
+        # a hang-triggered coordinated abort is environmental (a lost host, a
+        # DCN wedge), not deterministic — always worth the respawn budget.
+        # Only the signaling host records the hang cause; its peers record
+        # "peer signal" (the max-reduce carries a code, not a string), and on
+        # a shared report path the last writer wins — both spellings must
+        # bypass the give-up heuristic. A fleet-wide deterministic failure
+        # (every guard at budget) puts the guard reason on every host, so
+        # the give-up path still sees it no matter which report survives.
+        coord = cur.report.get("coordination") or {}
+        reason = str(coord.get("last_reason", ""))
+        if reason.startswith(("hang", "peer signal")):
+            return None
         if prev is None or prev.report is None or not prev.report.get("aborted"):
             return None
         prev_steps = prev.report.get("global_steps")

@@ -332,8 +332,13 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     x2 = h.reshape(S, D)
     v2 = (jnp.ones((S,), bool) if valid is None else valid.reshape(S))
     if s_pad != S:
-        x2 = jnp.concatenate([x2, jnp.zeros((s_pad - S, D), x2.dtype)], axis=0)
-        v2 = jnp.concatenate([v2, jnp.zeros((s_pad - S,), bool)], axis=0)
+        # pad, not concatenate: resharding a concatenate into the ep region
+        # trips a 0.4.x SPMD partitioner bug (the shard→replicated move is an
+        # add-all-reduce that double-counts the replicas of unmentioned mesh
+        # axes, scaling every row by the dp world size); jnp.pad lowers to a
+        # collective-free layout on every jax we target
+        x2 = jnp.pad(x2, ((0, s_pad - S), (0, 0)))
+        v2 = jnp.pad(v2, (0, s_pad - S))
     # router enters replicated-over-ep in fp32: its cotangent is a psum over
     # ep, and a *bf16* replicated-in grad trips an XLA:CPU check failure in
     # AllReducePromotion (all-reduce with copy reduction); fp32 sidesteps it

@@ -78,9 +78,12 @@ def per_module_profile(fn: Callable, *args, depth: int = 2,
         shapes[m.group(1)] = shape_of(m.group(3))
     # pass 2: dots + matmul-shaped convolutions (XLA:TPU lowers dots to
     # convolution) — operand shapes resolved through the definitions
+    # operands may carry a typed prefix (`dot(f32[32,64]{1,0} %lhs, ...)`,
+    # older XLA dumps) or be bare names (`dot(%lhs, ...)`, newer dumps)
+    _operand = r"(?:[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})? )?%?([\w.-]+)"
     inst = re.compile(
         r"= *[a-z0-9]+\[([0-9,]*)\][^=\n]* (dot|convolution)"
-        r"\(%?([\w.-]+), %?([\w.-]+)\)([^\n]*?)"
+        r"\(" + _operand + r", " + _operand + r"\)([^\n]*?)"
         r"metadata=\{[^}]*op_name=\"([^\"]+)\"")
     cdim_re = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
     label_re = re.compile(r"dim_labels=([a-z0-9]+)_")

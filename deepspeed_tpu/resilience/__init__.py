@@ -20,23 +20,45 @@ the unit of failure is a whole host:
   manifest + checksum, and load-time fallback to the previous verified tag;
 * :mod:`~deepspeed_tpu.resilience.guard` — :class:`StepGuard`: detects
   NaN/Inf loss or gradients, skips the step, rewinds the LR/loss-scale tick,
-  and aborts to the elastic agent after N consecutive bad steps. All recovery
-  events are counted and exposed through ``resilience_report()``, which the
-  elastic agent consumes to decide respawn vs. give-up.
+  and aborts to the elastic agent after N consecutive bad steps;
+* :mod:`~deepspeed_tpu.resilience.coordinator` —
+  :class:`ResilienceCoordinator`: folds local signals into one host
+  max-reduce per step boundary so the whole fleet agrees on
+  CONTINUE/SAVE/ABORT at the same step — no process commits ``latest`` or
+  exits to the agent unilaterally;
+* :mod:`~deepspeed_tpu.resilience.heartbeat` — :class:`Heartbeat` liveness
+  files + :class:`HangWatchdog`: stalled steps and stuck host collectives
+  are detected against configurable deadlines, classified (in-flight op,
+  comm timers, stale peers) and escalated into a coordinated ABORT (or a
+  hard exit) so the elastic agent respawns instead of wedging forever.
+
+All recovery events are counted and exposed through ``resilience_report()``,
+which the elastic agent consumes to decide respawn vs. give-up.
 """
 
+from deepspeed_tpu.resilience.coordinator import (ABORT, CONTINUE, SAVE,
+                                                  CoordinatedAbort,
+                                                  ResilienceCoordinator)
 from deepspeed_tpu.resilience.faults import (FaultInjector, InjectedCrash,
                                              InjectedIOError, get_injector,
                                              set_injector)
 from deepspeed_tpu.resilience.guard import StepGuard, TooManyBadSteps
+from deepspeed_tpu.resilience.heartbeat import HangWatchdog, Heartbeat
 from deepspeed_tpu.resilience.manager import CheckpointManager
 from deepspeed_tpu.resilience.retry import RetryDeadlineExceeded, RetryPolicy, retry_call
 
 __all__ = [
+    "ABORT",
+    "CONTINUE",
+    "SAVE",
     "CheckpointManager",
+    "CoordinatedAbort",
     "FaultInjector",
+    "HangWatchdog",
+    "Heartbeat",
     "InjectedCrash",
     "InjectedIOError",
+    "ResilienceCoordinator",
     "RetryDeadlineExceeded",
     "RetryPolicy",
     "StepGuard",

@@ -1,0 +1,167 @@
+"""Fleet-agreed resilience decisions.
+
+On a multi-host slice, PR 1's emergency save and step-guard abort were
+per-process decisions: one host could be committing ``latest`` (or exiting to
+the elastic agent) while its peers were still stepping — exactly the torn
+fleet the paper's elastic agent exists to prevent. The coordinator closes
+that hole: at each step boundary every process folds its local signals
+(preemption notice, step-guard abort budget, watchdog hang, injected faults)
+into a single int code and runs one tiny host collective (max-reduce) so the
+WHOLE fleet agrees on the same action at the same step:
+
+* ``CONTINUE`` (0) — nobody signaled; keep stepping.
+* ``SAVE`` (1) — someone holds a preemption notice; everyone commits the
+  SAME emergency tag (``preempt_step{N}``) this boundary, so the fleet's
+  ``latest`` pointers can never diverge.
+* ``ABORT`` (2) — someone cannot make progress (NaN budget spent, hung
+  collective); everyone raises :class:`CoordinatedAbort` this boundary and
+  the elastic agent respawns a coherent cohort.
+
+Max-reduce gives the natural dominance order (ABORT > SAVE > CONTINUE) with
+one scalar collective — the same ``comm.all_reduce_host`` plumbing the config
+consistency checks already ride (fault-injection and retry hooks included).
+Processes step in lockstep under SPMD, so "the same boundary" is well
+defined; ``interval_steps`` > 1 trades signal latency for collective rate and
+holds pending signals until the next scheduled agreement step.
+
+The agreed decision and the deciding step are recorded in the checkpoint
+manifest (``CheckpointManager.save(decision=...)``) so a post-mortem can
+distinguish "the fleet chose to save at step N" from an ordinary snapshot.
+
+Tests drive 2+ simulated processes by injecting ``reduce_fn`` (a
+barrier-backed thread max-reduce); production leaves it ``None`` and the
+real cross-process collective is used.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["CONTINUE", "SAVE", "ABORT", "DECISION_NAMES",
+           "CoordinatedAbort", "ResilienceCoordinator"]
+
+CONTINUE, SAVE, ABORT = 0, 1, 2
+DECISION_NAMES = {CONTINUE: "CONTINUE", SAVE: "SAVE", ABORT: "ABORT"}
+
+
+class CoordinatedAbort(RuntimeError):
+    """The fleet agreed to abort this incarnation (hang, peer failure, or a
+    step-guard budget spent somewhere); the elastic agent should respawn."""
+
+
+class ResilienceCoordinator:
+    """One per process. ``decide`` is called at every step boundary."""
+
+    def __init__(self, reduce_fn: Optional[Callable[[int], int]] = None,
+                 interval_steps: int = 1):
+        """``reduce_fn(code) -> agreed_code`` overrides the cross-process
+        max-reduce (tests inject a thread-barrier reduce; ``None`` uses
+        ``comm.all_reduce_host`` MAX). ``interval_steps`` runs the collective
+        every N boundaries — pending signals are held, never dropped."""
+        self._reduce = reduce_fn
+        self.interval_steps = max(1, int(interval_steps))
+        # signals arrive from other threads (SIGTERM handler, watchdog);
+        # the pending slot is read-and-reset by decide() — lock the window
+        # so a signal landing mid-decide is carried, never overwritten
+        self._lock = threading.Lock()
+        self._pending_code = CONTINUE
+        self._pending_reason = ""
+        # boundaries seen, NOT global_steps: skipped steps don't advance the
+        # step counter, and the interval gate must keep ticking through a
+        # NaN burst or a preemption would be held forever
+        self._boundaries = 0
+        self.last_decision = CONTINUE
+        self.last_decision_step = -1
+        self.last_reason = ""
+        self.counters: Dict[str, int] = {
+            "collectives": 0, "saves_agreed": 0, "aborts_agreed": 0,
+            "signals_save": 0, "signals_abort": 0, "decide_latency_us": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # local signals (set from any thread: SIGTERM handler, watchdog, guard)
+    # ------------------------------------------------------------------
+    def signal_save(self, reason: str = "") -> None:
+        self.counters["signals_save"] += 1
+        with self._lock:
+            if self._pending_code < SAVE:
+                self._pending_code, self._pending_reason = SAVE, reason
+
+    def signal_abort(self, reason: str = "") -> None:
+        self.counters["signals_abort"] += 1
+        with self._lock:
+            if self._pending_code < ABORT:
+                self._pending_code, self._pending_reason = ABORT, reason
+
+    # ------------------------------------------------------------------
+    def _agree(self, code: int) -> int:
+        if self._reduce is not None:
+            return int(self._reduce(code))
+        import numpy as np
+
+        from deepspeed_tpu import comm
+
+        # single-process this is a local no-op that still rides the
+        # fault-injection/retry hooks (slow/failed-collective drills apply)
+        return int(comm.all_reduce_host(np.int32(code), op=comm.MAX))
+
+    def decide(self, step: int, local_code: int = CONTINUE,
+               local_reason: str = "") -> int:
+        """Fold ``local_code`` + pending signals, agree with the fleet.
+
+        Off-interval boundaries return CONTINUE without a collective and keep
+        any pending signal armed — peers must enter the collective at the
+        same boundary, so a signal raised between agreement boundaries waits
+        for the next scheduled one. The interval counts BOUNDARIES (which
+        advance even when every step is skipped), not ``step``."""
+        with self._lock:
+            if local_code > self._pending_code:
+                self._pending_code = local_code
+                self._pending_reason = local_reason
+            self._boundaries += 1
+            if self.interval_steps > 1 \
+                    and self._boundaries % self.interval_steps != 0:
+                return CONTINUE
+            code, reason = self._pending_code, self._pending_reason
+            self._pending_code, self._pending_reason = CONTINUE, ""
+        t0 = time.monotonic()
+        agreed = self._agree(code)
+        self.counters["collectives"] += 1
+        self.counters["decide_latency_us"] += int(
+            (time.monotonic() - t0) * 1e6)
+        self.last_decision = agreed
+        self.last_decision_step = int(step)
+        if agreed != CONTINUE:
+            if agreed > code:
+                # the agreed action outranks this process's own vote: a peer
+                # drove it. The label must say so even when a weaker local
+                # vote (e.g. a pending SAVE under an agreed ABORT) carried
+                # its own reason — the agent keys respawn decisions on it.
+                self.last_reason = ("peer signal"
+                                    + (f" (local: {reason})" if reason
+                                       else ""))
+            else:
+                self.last_reason = reason or "peer signal"
+            key = "saves_agreed" if agreed == SAVE else "aborts_agreed"
+            self.counters[key] += 1
+            logger.warning(
+                f"resilience coordinator: fleet agreed "
+                f"{DECISION_NAMES[agreed]} at step {step} "
+                f"(local={DECISION_NAMES[code]}, reason={self.last_reason!r})")
+        return agreed
+
+    def decision_record(self) -> Dict:
+        """The manifest stamp for a coordinated save/abort."""
+        return {"decision": DECISION_NAMES[self.last_decision],
+                "step": self.last_decision_step,
+                "reason": self.last_reason}
+
+    def report(self) -> Dict:
+        return {"last_decision": DECISION_NAMES[self.last_decision],
+                "last_decision_step": self.last_decision_step,
+                "last_reason": self.last_reason,
+                "counters": dict(self.counters)}

@@ -52,6 +52,11 @@ class FaultSpec:
     ``step``: global step at which step-site faults fire (-1 = any step).
     ``times``: for occurrence-counted faults (failed_collective / io_error),
     how many consecutive calls fail before succeeding.
+    ``site``: narrows io_error/crash faults to one checkpoint-IO hook site
+    (``save`` | ``load`` | ``async_commit``); None fires at any IO site.
+    A ``crash`` spec with a ``site`` simulates host loss at that exact IO
+    point — e.g. ``{"kind": "crash", "site": "async_commit"}`` is the
+    preemption-between-stage-and-manifest drill.
     """
 
     kind: str
@@ -61,6 +66,7 @@ class FaultSpec:
     exit_code: int = 43         # crash: hard-exit code
     delay_s: float = 0.0        # slow_collective: injected latency
     mode: str = "truncate"      # torn_checkpoint: truncate | corrupt | unlink
+    site: Optional[str] = None  # io_error/crash: restrict to one IO hook site
 
     KINDS = ("crash", "nan_grads", "slow_collective", "failed_collective",
              "torn_checkpoint", "io_error")
@@ -147,9 +153,19 @@ class FaultInjector:
     # ---- checkpoint-site faults -------------------------------------------
     def on_checkpoint_io(self, what: str) -> None:
         for spec in self.faults:
-            if spec.kind == "io_error" and self._take(spec):
+            if spec.kind == "io_error" and spec.site in (None, what) \
+                    and self._take(spec):
                 self._record(spec, f"checkpoint_io:{what}")
                 raise InjectedIOError(f"injected checkpoint IO failure ({what})")
+            # a crash pinned to an IO site = host loss at that exact point
+            # (site REQUIRED: an un-sited crash spec keeps its step-site-only
+            # firing so existing drills are unchanged)
+            if spec.kind == "crash" and spec.site == what \
+                    and self._take(spec):
+                self._record(spec, f"checkpoint_io:{what}")
+                if spec.hard:
+                    os._exit(spec.exit_code)
+                raise InjectedCrash(f"injected crash at checkpoint IO ({what})")
 
     def maybe_tear_checkpoint(self, tag_dir: str, step: int) -> bool:
         """After a save: damage the newest tag so verification must reject it.

@@ -105,6 +105,18 @@ class StepGuard:
                      "update was already applied — resume from a checkpoint "
                      "if this escalates")
         if self.consecutive_bad >= self.max_consecutive_bad_steps:
+            # on a multi-process fleet the raise must not be unilateral (the
+            # peers would wedge in their next collective): register the vote
+            # and let the next boundary's coordinated decide abort EVERYONE.
+            # check_loss runs after this step's boundary, so the raise lands
+            # one step later than the imperative path — bounded by one step.
+            import jax
+
+            coord = getattr(self.engine, "_coordinator", None)
+            if coord is not None and jax.process_count() > 1:
+                coord.signal_abort(f"{self.consecutive_bad} consecutive "
+                                   "non-finite losses (fused path)")
+                return
             self.abort(f"{self.consecutive_bad} consecutive non-finite losses")
 
     # ------------------------------------------------------------------

@@ -11,6 +11,13 @@ Layered over ``runtime/checkpoint.py`` (which owns the orbax data format):
   points at);
 * a SIGTERM handler arms an emergency save that fires at the next step
   boundary — the TPU preemption notice → drain → save → exit flow;
+* ``async_save=True`` moves the commit half (manifest → ``latest`` → GC) to a
+  background committer thread while training continues: the tag directory
+  carries a ``.staging`` sentinel from first byte until the manifest is
+  durable, so a crash between stage and commit leaves a tag that load-time
+  verification REJECTS (falling back to the previous verified tag) instead of
+  a tag that merely looks legacy. ``drain()`` fences the committer at the
+  next save, any emergency save, every load, and engine shutdown;
 * all IO goes through :func:`~deepspeed_tpu.resilience.retry.retry_call`.
 
 Every recovery event is counted in :attr:`CheckpointManager.counters`, which
@@ -24,6 +31,7 @@ import json
 import os
 import shutil
 import signal
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -33,8 +41,12 @@ from deepspeed_tpu.utils.io import atomic_write_text
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 MANIFEST_FILE = "manifest.json"
+# present from stage start until the manifest commit: marks a tag whose data
+# may be complete on disk but whose integrity was never proven
+STAGING_FILE = ".staging"
 
-__all__ = ["CheckpointManager", "verify_tag_dir", "write_manifest"]
+__all__ = ["CheckpointManager", "verify_tag_dir", "write_manifest",
+           "STAGING_FILE"]
 
 
 def _sha256(path: str, chunk: int = 1 << 20) -> str:
@@ -52,14 +64,19 @@ def _walk_files(tag_dir: str) -> List[str]:
     out = []
     for root, _dirs, files in os.walk(tag_dir):
         for f in files:
-            if f == MANIFEST_FILE and root == tag_dir:
+            if f in (MANIFEST_FILE, STAGING_FILE) and root == tag_dir:
                 continue
             out.append(os.path.relpath(os.path.join(root, f), tag_dir))
     return sorted(out)
 
 
-def write_manifest(tag_dir: str, global_steps: int) -> str:
-    """Checksum every file under ``tag_dir`` into ``manifest.json``."""
+def write_manifest(tag_dir: str, global_steps: int,
+                   extra: Optional[Dict] = None) -> str:
+    """Checksum every file under ``tag_dir`` into ``manifest.json``.
+
+    ``extra`` merges additional metadata into the manifest — the coordinated
+    SAVE/ABORT decision record rides here so every tag names the fleet
+    decision (and deciding step) that produced it."""
     files = {}
     for rel in _walk_files(tag_dir):
         p = os.path.join(tag_dir, rel)
@@ -67,6 +84,7 @@ def write_manifest(tag_dir: str, global_steps: int) -> str:
     manifest = {"tag": os.path.basename(tag_dir),
                 "global_steps": int(global_steps),
                 "created": time.time(),
+                **(extra or {}),
                 "files": files}
     path = os.path.join(tag_dir, MANIFEST_FILE)
     atomic_write_text(path, json.dumps(manifest, indent=2))
@@ -100,17 +118,24 @@ class CheckpointManager:
 
     def __init__(self, save_dir: str, keep_last_k: int = 3,
                  verify: bool = True,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 async_save: bool = False):
         self.save_dir = os.path.abspath(save_dir)
         self.keep_last_k = int(keep_last_k)
         self.verify = bool(verify)
         self.retry_policy = retry_policy or RetryPolicy()
+        self.async_save = bool(async_save)
         self.preempted = False
         self._preempt_handler_installed = False
         self._prev_sigterm = None
+        # (thread, error_box, tag) of the in-flight async commit, if any
+        self._pending_async: Optional[Tuple] = None
+        self.async_stats: Dict[str, float] = {
+            "commits": 0, "last_latency_s": 0.0, "total_latency_s": 0.0}
         self.counters: Dict[str, int] = {
             "saves": 0, "emergency_saves": 0, "gc_removed": 0,
             "verify_failures": 0, "load_fallbacks": 0, "io_retries": 0,
+            "async_saves": 0, "async_commit_failures": 0, "staged_rejected": 0,
         }
 
     # ------------------------------------------------------------------
@@ -118,15 +143,40 @@ class CheckpointManager:
     # ------------------------------------------------------------------
     def save(self, engine, tag: Optional[str] = None,
              client_state: Optional[Dict] = None,
-             emergency: bool = False) -> str:
+             emergency: bool = False,
+             asynchronous: Optional[bool] = None,
+             decision: Optional[Dict] = None) -> str:
         """Commit protocol: data → manifest → atomic ``latest`` → GC.
 
         A crash at ANY point leaves either the previous checkpoint resumable
-        (latest untouched) or the new one fully verified."""
+        (latest untouched) or the new one fully verified. With
+        ``asynchronous`` (default: the manager's ``async_save``) the stage is
+        written inline but the manifest→``latest``→GC commit runs on a
+        background thread; the staged tag carries a ``.staging`` sentinel
+        until committed so a crash in the window is load-time rejectable.
+        Emergency saves always commit synchronously — the preemption grace
+        window is no place for a background thread. ``decision`` (a
+        coordinator ``decision_record()``) is stamped into the manifest."""
         from deepspeed_tpu.runtime import checkpoint as ckpt
 
+        self.drain(raise_on_error=False)  # one async commit in flight, ever
         tag = tag or f"global_step{engine.global_steps}"
+        # snapshot now: by the time the background committer runs, training
+        # has advanced engine.global_steps past the staged state
+        global_steps = int(engine.global_steps)
         inj = get_injector()
+        use_async = self.async_save if asynchronous is None else asynchronous
+        if emergency:
+            use_async = False
+        t0 = time.monotonic()
+        import jax
+
+        proc0 = jax.process_index() == 0
+        tag_dir = os.path.join(self.save_dir, tag)
+        if use_async and proc0:
+            os.makedirs(tag_dir, exist_ok=True)
+            atomic_write_text(os.path.join(tag_dir, STAGING_FILE),
+                              str(time.time()))
 
         def _on_retry(_attempt, _exc):
             self.counters["io_retries"] += 1
@@ -136,24 +186,106 @@ class CheckpointManager:
             path = ckpt.save_checkpoint(engine, self.save_dir, tag=tag,
                                         client_state=client_state,
                                         write_latest=False)
-            ckpt.finalize_pending(engine)  # manifest must see committed bytes
+            if not use_async:
+                ckpt.finalize_pending(engine)  # manifest must see final bytes
             return path
 
         path = retry_call(_save, policy=self.retry_policy,
                           what=f"checkpoint save ({tag})", on_retry=_on_retry)
-        import jax
 
-        if jax.process_index() == 0:
-            write_manifest(path, engine.global_steps)
-            # a configured torn_checkpoint fault damages the tag here — after
-            # the manifest, before latest — modeling a torn write that the
-            # load-time verification must catch
-            inj.maybe_tear_checkpoint(path, engine.global_steps)
-            ckpt.write_latest_atomic(self.save_dir, tag)
-            self._gc()
-        self.counters["emergency_saves" if emergency else "saves"] += 1
-        log_dist(f"checkpoint committed: {path} (emergency={emergency})")
+        def _commit():
+            # the window between stage and this point is the crash drill:
+            # an injected io_error/crash at site "async_commit" (or a real
+            # host loss) leaves the sentinel in place and latest untouched.
+            # finalize_pending (the orbax flush) is NOT retried on the async
+            # path: retrying would require restaging, which only the caller
+            # thread can do — a failed stage is counted and superseded by
+            # the next save, while latest keeps the previous verified tag.
+            ckpt.finalize_pending(engine)
+            if use_async:
+                inj.on_checkpoint_io("async_commit")
+
+            def _manifest_io():
+                write_manifest(path, global_steps, extra=(
+                    {"coordination": decision} if decision else None))
+                staging = os.path.join(path, STAGING_FILE)
+                if os.path.exists(staging):
+                    os.unlink(staging)
+
+            def _latest_io():
+                ckpt.write_latest_atomic(self.save_dir, tag)
+                self._gc()
+
+            if proc0:
+                # the commit-protocol IO is ordinary filesystem IO: transient
+                # remote-FS blips get the same RetryPolicy as the stage.
+                # Retried in two phases so the injected tear point stays
+                # strictly between manifest and latest (a retry must never
+                # re-checksum post-tear data into a passing manifest).
+                retry_call(_manifest_io, policy=self.retry_policy,
+                           what=f"checkpoint manifest ({tag})",
+                           on_retry=_on_retry)
+                # a configured torn_checkpoint fault damages the tag here —
+                # after the manifest, before latest — modeling a torn write
+                # that the load-time verification must catch
+                inj.maybe_tear_checkpoint(path, global_steps)
+                retry_call(_latest_io, policy=self.retry_policy,
+                           what=f"checkpoint latest ({tag})",
+                           on_retry=_on_retry)
+
+        if use_async:
+            error_box: list = []
+
+            def _commit_bg():
+                try:
+                    _commit()
+                    dt = time.monotonic() - t0
+                    # a committed async save IS a save: the long-standing
+                    # counter must not read 0 just because commits moved to
+                    # a background thread
+                    self.counters["saves"] += 1
+                    self.async_stats["commits"] += 1
+                    self.async_stats["last_latency_s"] = dt
+                    self.async_stats["total_latency_s"] += dt
+                    log_dist(f"async checkpoint committed: {path} "
+                             f"({dt:.2f}s stage→commit)")
+                except BaseException as e:
+                    error_box.append(e)
+                    self.counters["async_commit_failures"] += 1
+                    logger.exception(
+                        f"async checkpoint commit FAILED for {path}; latest "
+                        "still names the previous verified tag")
+
+            # non-daemon: interpreter exit joins the committer, so the final
+            # save of a run always gets its manifest + latest
+            t = threading.Thread(target=_commit_bg, daemon=False,
+                                 name=f"ckpt-async-commit-{tag}")
+            t.start()
+            self._pending_async = (t, error_box, tag)
+            self.counters["async_saves"] += 1
+            log_dist(f"checkpoint staged: {path} (commit in background)")
+        else:
+            _commit()
+            self.counters["emergency_saves" if emergency else "saves"] += 1
+            log_dist(f"checkpoint committed: {path} (emergency={emergency})")
         return path
+
+    def drain(self, raise_on_error: bool = True) -> None:
+        """Block until the in-flight async commit (if any) finishes.
+
+        Fences every ordering point: the next save, emergency saves, loads,
+        and engine shutdown. A commit error is re-raised by default (callers
+        that must make progress anyway — the next save supersedes the failed
+        one — pass ``raise_on_error=False``; the failure is already counted
+        and logged)."""
+        pending = self._pending_async
+        if pending is None:
+            return
+        self._pending_async = None
+        thread, error_box, tag = pending
+        thread.join()
+        if error_box and raise_on_error:
+            raise error_box[0]
 
     # ------------------------------------------------------------------
     # load with fallback
@@ -204,6 +336,7 @@ class CheckpointManager:
         or ``(None, {})`` when nothing loadable exists."""
         from deepspeed_tpu.runtime import checkpoint as ckpt
 
+        self.drain(raise_on_error=False)  # a staged tag may be the wanted one
         candidates = [tag] if tag is not None else self._tags_newest_first()
         if not candidates:
             logger.warning(f"no checkpoints under {self.save_dir}")
@@ -213,6 +346,18 @@ class CheckpointManager:
         last_err: Optional[str] = None
         for cand in candidates:
             tag_dir = os.path.join(self.save_dir, cand)
+            if os.path.exists(os.path.join(tag_dir, STAGING_FILE)):
+                # staged-but-never-committed async save (crash between stage
+                # and manifest): data may LOOK complete, but integrity was
+                # never proven — reject it like a failed verification rather
+                # than letting it pass as a legacy pre-manifest tag
+                self.counters["staged_rejected"] += 1
+                self.counters["verify_failures"] += 1
+                logger.error(f"checkpoint {cand} is an uncommitted async "
+                             "stage (crash between stage and commit); "
+                             "stepping back")
+                last_err = f"{cand}: uncommitted async stage"
+                continue
             if self.verify:
                 if not os.path.exists(os.path.join(tag_dir, MANIFEST_FILE)):
                     # legacy tag saved before resilience was enabled: there

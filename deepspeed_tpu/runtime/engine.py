@@ -262,9 +262,12 @@ class DeepSpeedTpuEngine:
                         "layers receive no gradients but decoupled decay "
                         "would keep shrinking them every step")
 
-        # ---- resilience (step guard, retries, fault injection) ---------
+        # ---- resilience (guard, retries, coordination, heartbeat) -------
         rcfg = config.resilience
         self._guard = None
+        self._coordinator = None
+        self._heartbeat = None
+        self._watchdog = None
         self._ckpt_managers: Dict[str, Any] = {}
         self._primary_mgr = None
         self._resilience_report_dir = os.environ.get("DSTPU_CHECKPOINT_DIR")
@@ -278,6 +281,53 @@ class DeepSpeedTpuEngine:
             self._guard = StepGuard(
                 self, max_consecutive_bad_steps=rcfg.max_consecutive_bad_steps)
             comm_mod.set_retry_policy(RetryPolicy(**rcfg.retry.model_dump()))
+            if rcfg.coordination.enabled:
+                from deepspeed_tpu.resilience.coordinator import \
+                    ResilienceCoordinator
+
+                self._coordinator = ResilienceCoordinator(
+                    interval_steps=rcfg.coordination.interval_steps)
+            if rcfg.heartbeat.enabled:
+                from deepspeed_tpu.resilience.heartbeat import (HangWatchdog,
+                                                                Heartbeat)
+
+                if rcfg.heartbeat.on_hang == "abort" \
+                        and self._coordinator is None:
+                    # the default escalation routes through the coordinated
+                    # decide; without it the watchdog would detect and then
+                    # do nothing — the exact wedge it exists to prevent
+                    raise ValueError(
+                        "resilience.heartbeat.on_hang='abort' requires "
+                        "resilience.coordination.enabled; use on_hang="
+                        "'exit' (hard wedges) or 'report' instead")
+                hb_dir = rcfg.heartbeat.dir
+                if hb_dir is None and self._resilience_report_dir:
+                    hb_dir = os.path.join(self._resilience_report_dir,
+                                          "heartbeats")
+                if hb_dir is None:
+                    # liveness still works per-process, but peers can only
+                    # be classified off a SHARED directory — say so loudly
+                    # instead of silently littering the cwd
+                    import tempfile
+
+                    hb_dir = os.path.join(
+                        tempfile.gettempdir(),
+                        f"dstpu_heartbeats_{os.getpid()}")
+                    logger.warning(
+                        "resilience.heartbeat.dir is unset and no checkpoint "
+                        f"dir is known; writing heartbeats to {hb_dir} — "
+                        "peer straggler classification needs a shared "
+                        "directory (set heartbeat.dir or "
+                        "DSTPU_CHECKPOINT_DIR)")
+                self._heartbeat = Heartbeat(
+                    hb_dir, interval_s=rcfg.heartbeat.interval_s).start()
+                self._watchdog = HangWatchdog(
+                    self._heartbeat, deadline_s=rcfg.heartbeat.deadline_s,
+                    collective_deadline_s=rcfg.heartbeat.collective_deadline_s,
+                    poll_s=rcfg.heartbeat.poll_s,
+                    coordinator=self._coordinator,
+                    on_hang=rcfg.heartbeat.on_hang,
+                    exit_code=rcfg.heartbeat.exit_code).start()
             if self._resilience_report_dir:
                 # launched under the elastic agent: arm the preemption
                 # handler against the agent's checkpoint dir right away
@@ -795,16 +845,12 @@ class DeepSpeedTpuEngine:
                 ("Train/Samples/train_loss", float(self._last_loss), self.global_samples),
                 ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
             ])
-        if self._primary_mgr is not None and self._primary_mgr.preempted:
-            # the step boundary is the consistent point: params/opt state are
-            # complete trees — but an overlapped host-offload step may still
-            # be in flight; drain it so the snapshot matches global_steps
-            if self._offload is not None and self._offload.overlap:
-                self._collect_offload()
-            self._primary_mgr.maybe_emergency_save(self)
-            rc = self.config.resilience.checkpoint
-            if rc.exit_on_preempt:
-                raise SystemExit(rc.preempt_exit_code)
+            if self.global_steps and \
+                    self.global_steps % self.config.steps_per_print == 0:
+                self.monitor.write_events(self._resilience_events())
+        if self._heartbeat is not None:
+            self._heartbeat.notify_step(self.global_steps)
+        self._resilience_step_boundary()
 
     def train_batch(self, data_iter: Optional[Iterable] = None):
         """One full global batch = GA micro-steps + optimizer step
@@ -1058,6 +1104,149 @@ class DeepSpeedTpuEngine:
     def _resilience_enabled(self) -> bool:
         return bool(self.config.resilience.enabled)
 
+    def _resilience_step_boundary(self) -> None:
+        """Fold local signals into the fleet decision at this boundary.
+
+        With coordination on (the default under ``resilience.enabled``) no
+        process saves ``latest`` or exits unilaterally: SIGTERM/preemption,
+        step-guard budget, and watchdog hangs become votes in one host
+        max-reduce, and every process acts on the agreed code at the same
+        step. With coordination off this degrades to PR 1's local-only
+        emergency save."""
+        mgr, guard = self._primary_mgr, self._guard
+        if self._coordinator is None:
+            if mgr is not None and mgr.preempted:
+                # uncoordinated fallback: per-process emergency save
+                if self._offload is not None and self._offload.overlap:
+                    self._collect_offload()
+                mgr.maybe_emergency_save(self)
+                rc = self.config.resilience.checkpoint
+                if rc.exit_on_preempt:
+                    raise SystemExit(rc.preempt_exit_code)
+            return
+        from deepspeed_tpu.resilience.coordinator import (ABORT, CONTINUE,
+                                                          SAVE)
+
+        local, reason = CONTINUE, ""
+        if mgr is not None and mgr.preempted:
+            local, reason = SAVE, "preemption notice (SIGTERM)"
+        if guard is not None and \
+                guard.consecutive_bad >= guard.max_consecutive_bad_steps:
+            local, reason = ABORT, (f"{guard.consecutive_bad} consecutive "
+                                    "non-finite steps")
+        decision = self._coordinator.decide(self.global_steps, local, reason)
+        if decision == SAVE:
+            self._coordinated_emergency_save()
+        elif decision == ABORT:
+            self._coordinated_abort()
+
+    def _coordinated_emergency_save(self) -> None:
+        """Every process commits the SAME emergency tag this boundary."""
+        coord = self._coordinator
+        mgr = self._primary_mgr
+        if mgr is None and self._resilience_report_dir:
+            mgr = self._resilience_manager(self._resilience_report_dir)
+        if mgr is None:
+            logger.error("fleet agreed SAVE but no checkpoint dir is known "
+                         "(set DSTPU_CHECKPOINT_DIR or save once first); "
+                         "skipping the emergency save")
+            return
+        # the step boundary is the consistent point: params/opt state are
+        # complete trees — but an overlapped host-offload step may still
+        # be in flight; drain it so the snapshot matches global_steps
+        if self._offload is not None and self._offload.overlap:
+            self._collect_offload()
+        mgr.preempted = False  # consumed fleet-wide, signaled host or not
+        tag = f"preempt_step{self.global_steps}"
+        path = mgr.save(self, tag=tag, emergency=True,
+                        decision=coord.decision_record())
+        logger.warning(f"coordinated emergency checkpoint saved to {path}")
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("resilience/decision", float(SAVE), self.global_samples)])
+        rc = self.config.resilience.checkpoint
+        if rc.exit_on_preempt:
+            raise SystemExit(rc.preempt_exit_code)
+
+    def _coordinated_abort(self) -> None:
+        """Every process exits to the elastic agent at the same step."""
+        from deepspeed_tpu.resilience.coordinator import ABORT, CoordinatedAbort
+
+        coord, guard = self._coordinator, self._guard
+        reason = coord.last_reason or "peer abort"
+        if self.monitor is not None:
+            self.monitor.write_events(
+                [("resilience/decision", float(ABORT), self.global_samples)])
+        if guard is not None and \
+                guard.consecutive_bad >= guard.max_consecutive_bad_steps:
+            # this process's own guard budget is the cause: keep the
+            # established abort path (report write + TooManyBadSteps)
+            guard.abort(reason)
+        if self._resilience_report_dir:
+            try:
+                self.write_resilience_report(self._resilience_report_dir)
+            except OSError as e:
+                logger.error(f"could not write resilience report: {e}")
+        logger.error(f"coordinated abort to the elastic agent: {reason}")
+        raise CoordinatedAbort(reason)
+
+    def _resilience_events(self):
+        """The ``resilience/*`` monitor stream: one gauge per counter the
+        report exposes, written at the ``steps_per_print`` cadence (and on
+        every non-CONTINUE decision)."""
+        from deepspeed_tpu import comm as comm_mod
+
+        s = self.global_samples
+        events = [("resilience/skipped_steps", float(self.skipped_steps), s),
+                  ("resilience/comm_retries",
+                   float(comm_mod.get_retry_stats()["retries"]), s)]
+        if self._guard is not None:
+            events += [
+                ("resilience/guard_bad_steps_skipped",
+                 float(self._guard.counters["bad_steps_skipped"]), s),
+                ("resilience/guard_consecutive_bad",
+                 float(self._guard.consecutive_bad), s)]
+        agg: Dict[str, float] = {}
+        for mgr in self._ckpt_managers.values():
+            for k, v in mgr.counters.items():
+                agg[k] = agg.get(k, 0) + v
+            if mgr.async_stats["commits"]:
+                events.append(("resilience/async_save_latency_s",
+                               float(mgr.async_stats["last_latency_s"]), s))
+        for k in ("emergency_saves", "verify_failures", "load_fallbacks",
+                  "gc_removed", "io_retries", "async_saves",
+                  "async_commit_failures"):
+            if k in agg:
+                events.append((f"resilience/ckpt_{k}", float(agg[k]), s))
+        if self._coordinator is not None:
+            c = self._coordinator.counters
+            events += [("resilience/decisions_save",
+                        float(c["saves_agreed"]), s),
+                       ("resilience/decisions_abort",
+                        float(c["aborts_agreed"]), s)]
+        if self._watchdog is not None:
+            w = self._watchdog.counters
+            events += [("resilience/hangs_detected",
+                        float(w["hangs_detected"]), s),
+                       ("resilience/heartbeat_max_peer_gap_s",
+                        float(w["max_peer_gap_s"]), s)]
+        if self._heartbeat is not None:
+            events.append(("resilience/heartbeat_step_age_s",
+                           float(self._heartbeat.step_age_s()), s))
+        return events
+
+    def shutdown(self) -> None:
+        """Orderly teardown: drain in-flight async work (offload step, async
+        checkpoint commits) and stop the resilience threads. Idempotent."""
+        if self._offload is not None and self._offload.overlap:
+            self._collect_offload()
+        for mgr in self._ckpt_managers.values():
+            mgr.drain(raise_on_error=False)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+
     def _resilience_manager(self, ckpt_dir: str):
         """One CheckpointManager per checkpoint directory; the first becomes
         the preemption-save target."""
@@ -1070,7 +1259,8 @@ class DeepSpeedTpuEngine:
             mgr = CheckpointManager(
                 ckpt_dir, keep_last_k=rc.checkpoint.keep_last_k,
                 verify=rc.checkpoint.verify,
-                retry_policy=RetryPolicy(**rc.retry.model_dump()))
+                retry_policy=RetryPolicy(**rc.retry.model_dump()),
+                async_save=rc.checkpoint.async_save)
             if rc.checkpoint.save_on_preempt:
                 mgr.install_preemption_handler()
             self._ckpt_managers[key] = mgr
@@ -1081,27 +1271,44 @@ class DeepSpeedTpuEngine:
         return mgr
 
     def resilience_report(self) -> Dict[str, Any]:
-        """Recovery-event counters for the elastic agent's respawn-vs-give-up
-        decision (and for operators): step-guard skips/aborts, checkpoint
-        verification failures/fallbacks/GC, comm retries, faults fired."""
+        """The FULL recovery picture in one call, for the elastic agent's
+        respawn-vs-give-up decision and for operators: step-guard
+        skips/aborts, checkpoint verification failures/fallbacks/GC,
+        async-save commit stats, comm retries + the in-flight collective,
+        coordination decisions, heartbeat/hang counters, faults fired."""
         from deepspeed_tpu import comm as comm_mod
         from deepspeed_tpu.resilience.faults import get_injector
 
         ckpt: Dict[str, int] = {}
+        async_stats = {"commits": 0, "last_latency_s": 0.0,
+                       "total_latency_s": 0.0}
         for mgr in self._ckpt_managers.values():
             for k, v in mgr.counters.items():
                 ckpt[k] = ckpt.get(k, 0) + v
+            for k, v in mgr.async_stats.items():
+                async_stats[k] = (max(async_stats[k], v)
+                                  if k == "last_latency_s"
+                                  else async_stats[k] + v)
         guard = self._guard
+        aborted = bool(guard.counters["aborts"]) if guard else False
+        coord = self._coordinator
+        if coord is not None:
+            aborted = aborted or bool(coord.counters["aborts_agreed"])
         return {
-            "schema": 1,
+            "schema": 2,
             "global_steps": self.global_steps,
             "skipped_steps": self.skipped_steps,
             "guard": dict(guard.counters) if guard is not None else {},
             "consecutive_bad_steps": (guard.consecutive_bad
                                       if guard is not None else 0),
-            "aborted": bool(guard.counters["aborts"]) if guard else False,
+            "aborted": aborted,
             "checkpoint": ckpt,
-            "comm": comm_mod.get_retry_stats(),
+            "checkpoint_async": async_stats,
+            "comm": {**comm_mod.get_retry_stats(),
+                     "inflight": comm_mod.get_inflight()},
+            "coordination": coord.report() if coord is not None else {},
+            "heartbeat": (self._watchdog.report()
+                          if self._watchdog is not None else {}),
             "faults_fired": list(get_injector().fired),
         }
 

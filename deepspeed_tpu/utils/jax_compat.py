@@ -131,10 +131,57 @@ def _compat_axis_size(axis_name) -> int:
     return _core.axis_frame(axis_name)
 
 
+def _patch_eager_memory_kind_device_put() -> None:
+    """0.4.x: ``jax.device_put(x, TransferToMemoryKind(...))`` outside jit
+    raises instead of transferring. Resolve the memory kind against the
+    array's own device; when the backend does not expose that memory space
+    at all (XLA:CPU has no ``pinned_host``) degrade to a same-memory no-op —
+    values are unchanged, only the placement hint is dropped. This is what
+    lets the remat offload policies (``offload_attn``/``offload_dots``) run
+    eagerly (e.g. ``jax.grad`` without an enclosing ``jax.jit``)."""
+    try:
+        from jax._src import dispatch as _dispatch
+        from jax._src.sharding_impls import TransferToMemoryKind
+    except ImportError:  # pragma: no cover - internals moved; newer jax
+        return
+
+    orig = _dispatch._device_put_impl
+
+    def _resolve(x, tmk):
+        try:
+            dev = (next(iter(x.devices())) if hasattr(x, "devices")
+                   else jax.devices()[0])
+        except Exception:
+            dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if tmk.memory_kind not in kinds:
+            return None  # backend has no such memory space: keep placement
+        # keep the array's own sharding (a multi-device array must not be
+        # silently gathered onto one device), only the memory kind moves
+        sh = getattr(x, "sharding", None)
+        if sh is not None and hasattr(sh, "with_memory_kind"):
+            try:
+                return sh.with_memory_kind(tmk.memory_kind)
+            except Exception:
+                pass
+        return jax.sharding.SingleDeviceSharding(
+            dev, memory_kind=tmk.memory_kind)
+
+    def impl(x, *, device, src, copy):
+        if isinstance(src, TransferToMemoryKind):
+            src = None
+        if isinstance(device, TransferToMemoryKind):
+            device = _resolve(x, device)
+        return orig(x, device=device, src=src, copy=copy)
+
+    _dispatch._device_put_impl = impl
+
+
 def install() -> None:
     from jax import lax
 
     if not hasattr(jax, "shard_map"):
+        _patch_eager_memory_kind_device_put()
         jax.shard_map = _compat_shard_map
         # The full-manual lowering above breaks sharding constraints inside
         # shard_map bodies (every mesh axis is manual there, and 0.4.x

@@ -1,0 +1,25 @@
+"""Pytest wrappers for the chaos drill CLI (``tools/chaos_drill.py``).
+
+Each drill builds a real engine, injects a named fault scenario, and checks
+the recovery invariant end to end — marked ``chaos`` + ``slow`` so CI can run
+them on demand (``-m chaos``) without taxing the tier-1 fast suite."""
+
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+sys.path.insert(0, _TOOLS)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario",
+                         ["preempt-mid-save", "nan-burst", "hung-collective"])
+def test_chaos_scenario(scenario, tmp_path, eight_devices):
+    from chaos_drill import run_scenario
+
+    verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict

@@ -8,6 +8,9 @@ import json
 import os
 import signal
 import textwrap
+import threading
+import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -16,12 +19,15 @@ import deepspeed_tpu as ds
 from deepspeed_tpu import comm
 from deepspeed_tpu.elasticity import ElasticAgent, subprocess_spawn
 from deepspeed_tpu.models import TransformerLM, get_preset
-from deepspeed_tpu.resilience import (FaultInjector, InjectedIOError,
+from deepspeed_tpu.resilience import (ABORT, CONTINUE, SAVE,
+                                      CheckpointManager, CoordinatedAbort,
+                                      FaultInjector, InjectedIOError,
+                                      ResilienceCoordinator,
                                       RetryDeadlineExceeded, RetryPolicy,
                                       TooManyBadSteps, retry_call,
                                       set_injector)
 from deepspeed_tpu.resilience.faults import tear_checkpoint_dir
-from deepspeed_tpu.resilience.manager import verify_tag_dir
+from deepspeed_tpu.resilience.manager import STAGING_FILE, verify_tag_dir
 
 
 @pytest.fixture(autouse=True)
@@ -331,6 +337,340 @@ class TestCheckpointManager:
 
 
 # ---------------------------------------------------------------------------
+# Multi-host coordination (simulated processes)
+# ---------------------------------------------------------------------------
+
+class ThreadFleet:
+    """Barrier-backed max-reduce over N thread-simulated processes — the test
+    stand-in for ``comm.all_reduce_host(code, op=MAX)`` on a real slice."""
+
+    def __init__(self, n):
+        self.n = n
+        self.barrier = threading.Barrier(n, timeout=30)
+        self.vals = [0] * n
+
+    def reducer(self, rank):
+        def reduce(code):
+            self.vals[rank] = int(code)
+            self.barrier.wait()
+            out = max(self.vals)
+            self.barrier.wait()   # nobody rearms vals before everyone read
+            return out
+        return reduce
+
+    def run(self, proc):
+        """Run ``proc(rank)`` on N threads; re-raise the first failure."""
+        errors = []
+
+        def body(rank):
+            try:
+                proc(rank)
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=body, args=(r,))
+                   for r in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if errors:
+            raise errors[0]
+
+
+def _fake_engine(step=5):
+    """The minimal engine surface ``CheckpointManager.save`` touches —
+    lets coordination drills run one simulated process per thread without
+    paying an XLA compile per 'host'."""
+    import jax.numpy as jnp
+
+    return SimpleNamespace(
+        params={"w": jnp.arange(4.0)},
+        opt_state={"m": jnp.zeros(4)},
+        scaler_state={"scale": jnp.float32(1.0), "good_steps": jnp.int32(0)},
+        global_steps=step, global_samples=step * 8, micro_steps=step,
+        skipped_steps=0, zero_stage=0,
+        topology=SimpleNamespace(axis_sizes={}),
+        lr_scheduler=None, _offload=None, _pending_ckpt=None,
+        config=SimpleNamespace(checkpoint=SimpleNamespace(async_save=False)))
+
+
+class TestCoordination:
+    def test_divergent_preempt_signal_commits_identical_tag(self, tmp_path):
+        """The acceptance drill: one simulated process gets the SIGTERM, its
+        peer does not — the max-reduce turns the split-brain into a fleet
+        SAVE, and every process commits the IDENTICAL tag with the decision
+        recorded in its manifest."""
+        fleet = ThreadFleet(2)
+        tags = [None, None]
+
+        def proc(rank):
+            eng = _fake_engine(step=5)
+            mgr = CheckpointManager(str(tmp_path / f"host{rank}"))
+            coord = ResilienceCoordinator(reduce_fn=fleet.reducer(rank))
+            if rank == 0:
+                mgr.preempted = True          # only host 0 was preempted
+            local = SAVE if mgr.preempted else CONTINUE
+            decision = coord.decide(eng.global_steps, local,
+                                    "preemption notice" if local else "")
+            assert decision == SAVE           # ...but BOTH agree to save
+            mgr.preempted = False
+            tag = f"preempt_step{eng.global_steps}"
+            mgr.save(eng, tag=tag, emergency=True,
+                     decision=coord.decision_record())
+            tags[rank] = tag
+
+        fleet.run(proc)
+        assert tags[0] == tags[1] == "preempt_step5"
+        from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+        for rank in range(2):
+            host = tmp_path / f"host{rank}"
+            ok, why = verify_tag_dir(str(host / tags[rank]))
+            assert ok, why
+            assert read_latest_tag(str(host)) == tags[rank]
+            manifest = json.load(open(host / tags[rank] / "manifest.json"))
+            # the decision + step are fleet-identical; the reason is local
+            # (only the code crosses the wire) — the unsignaled peer records
+            # that it acted on a peer's signal
+            assert manifest["coordination"]["decision"] == "SAVE"
+            assert manifest["coordination"]["step"] == 5
+        m0 = json.load(open(tmp_path / "host0" / tags[0] / "manifest.json"))
+        m1 = json.load(open(tmp_path / "host1" / tags[1] / "manifest.json"))
+        assert m0["coordination"]["reason"] == "preemption notice"
+        assert m1["coordination"]["reason"] == "peer signal"
+
+    def test_peer_abort_vote_reaches_everyone(self):
+        """An abort signaled on ONE process (watchdog hang, guard budget)
+        aborts EVERY process at the same agreement step."""
+        fleet = ThreadFleet(3)
+        decisions = [None] * 3
+
+        def proc(rank):
+            coord = ResilienceCoordinator(reduce_fn=fleet.reducer(rank))
+            if rank == 1:
+                coord.signal_abort("hang: stuck collective all_reduce_host")
+            decisions[rank] = coord.decide(7)
+
+        fleet.run(proc)
+        assert decisions == [ABORT, ABORT, ABORT]
+
+    def test_abort_dominates_save(self):
+        """One host preempted, another wedged: the fleet must ABORT (the
+        wedged host cannot participate in a coherent save)."""
+        fleet = ThreadFleet(2)
+        decisions = [None, None]
+
+        def proc(rank):
+            coord = ResilienceCoordinator(reduce_fn=fleet.reducer(rank))
+            (coord.signal_save if rank == 0 else coord.signal_abort)("x")
+            decisions[rank] = coord.decide(3)
+
+        fleet.run(proc)
+        assert decisions == [ABORT, ABORT]
+
+    def test_interval_holds_signal_until_scheduled_step(self):
+        coord = ResilienceCoordinator(reduce_fn=lambda c: c, interval_steps=2)
+        coord.signal_save("preempt")
+        assert coord.decide(3) == CONTINUE    # off-interval: held, not lost
+        assert coord.decide(4) == SAVE        # scheduled boundary: fires
+        assert coord.counters["collectives"] == 1
+
+    def test_single_process_decide_rides_comm_hooks(self):
+        """Decide goes through ``all_reduce_host`` even at world=1, so the
+        fault-injection and retry plumbing applies to the decision plane."""
+        set_injector(FaultInjector([{"kind": "failed_collective", "times": 1}]))
+        comm.set_retry_policy(RetryPolicy(max_attempts=2, base_delay_s=0.001))
+        coord = ResilienceCoordinator()
+        coord.signal_abort("drill")
+        assert coord.decide(1) == ABORT
+        assert comm.get_retry_stats()["retries"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Async checkpointing (manifest-committed background saves)
+# ---------------------------------------------------------------------------
+
+class TestAsyncCheckpoint:
+    CFG = {"checkpoint": {"async_save": True}}
+
+    def test_async_save_commits_in_background(self, tmp_path, eight_devices):
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=make_config(resilience=dict(self.CFG)))
+        train_steps(eng, 1)
+        eng.save_checkpoint(str(tmp_path))
+        mgr = eng._primary_mgr
+        assert mgr.counters["async_saves"] == 1
+        mgr.drain()
+        from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+        ok, why = verify_tag_dir(str(tmp_path / "global_step1"))
+        assert ok, why
+        assert read_latest_tag(str(tmp_path)) == "global_step1"
+        assert not (tmp_path / "global_step1" / STAGING_FILE).exists()
+        rep = eng.resilience_report()
+        # satellite: one call returns the full picture
+        assert rep["checkpoint"]["async_saves"] == 1
+        assert rep["checkpoint_async"]["commits"] == 1
+        assert rep["checkpoint_async"]["last_latency_s"] > 0
+        assert "retries" in rep["comm"] and "inflight" in rep["comm"]
+        assert rep["coordination"]["counters"]["collectives"] >= 1
+        eng.shutdown()
+
+    def test_crash_between_stage_and_commit_falls_back(self, tmp_path,
+                                                       eight_devices):
+        """The acceptance drill: the commit thread dies between the staged
+        data and the manifest — after 'restart', load lands on the PREVIOUS
+        verified tag and the staged tag is rejected, not mistaken for a
+        legacy pre-manifest checkpoint."""
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=make_config(resilience=dict(self.CFG)))
+        train_steps(eng, 2)
+        eng.save_checkpoint(str(tmp_path))          # global_step2
+        eng._primary_mgr.drain()                    # committed + verified
+        train_steps(eng, 1)
+        set_injector(FaultInjector(
+            [{"kind": "io_error", "site": "async_commit"}]))
+        eng.save_checkpoint(str(tmp_path))          # global_step3: stage only
+        eng._primary_mgr.drain(raise_on_error=False)
+        set_injector(None)
+        assert eng._primary_mgr.counters["async_commit_failures"] == 1
+        from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+        assert (tmp_path / "global_step3" / STAGING_FILE).exists()
+        assert not (tmp_path / "global_step3" / "manifest.json").exists()
+        assert read_latest_tag(str(tmp_path)) == "global_step2"
+
+        # restart-and-load: the previous verified tag comes back
+        eng2, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                 config=make_config(resilience=dict(self.CFG)))
+        path, _ = eng2.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("global_step2")
+        assert eng2.global_steps == 2
+        # asking for the staged tag explicitly is refused, not half-loaded
+        with pytest.raises(RuntimeError, match="uncommitted async stage"):
+            eng2.load_checkpoint(str(tmp_path), tag="global_step3")
+        assert eng2.resilience_report()["checkpoint"]["staged_rejected"] == 1
+        eng2.shutdown()
+        eng.shutdown()
+
+    def test_emergency_save_drains_pending_and_commits_sync(self, tmp_path,
+                                                            eight_devices):
+        """SIGTERM with an async commit in flight: the emergency save fences
+        the committer first and commits synchronously — the grace window
+        never races a background thread."""
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=make_config(resilience=dict(self.CFG)))
+        train_steps(eng, 1)
+        eng.save_checkpoint(str(tmp_path))          # async, maybe in flight
+        os.kill(os.getpid(), signal.SIGTERM)
+        train_steps(eng, 1)                         # boundary: agreed SAVE
+        mgr = eng._primary_mgr
+        assert mgr.counters["emergency_saves"] == 1
+        assert mgr._pending_async is None
+        ok, why = verify_tag_dir(str(tmp_path / "preempt_step2"))
+        assert ok, why
+        manifest = json.load(open(tmp_path / "preempt_step2" / "manifest.json"))
+        assert manifest["coordination"]["decision"] == "SAVE"
+        assert manifest["coordination"]["step"] == 2
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat + hang watchdog
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatWatchdog:
+    def _cfg(self, tmp_path, faults=None, **hb):
+        base = {"enabled": True, "dir": str(tmp_path / "hb"),
+                "interval_s": 0.05, "poll_s": 0.05,
+                "deadline_s": 30.0, "collective_deadline_s": None}
+        base.update(hb)
+        res = {"heartbeat": base}
+        if faults:
+            res["faults"] = faults
+        return make_config(resilience=res)
+
+    def test_stall_escalates_to_coordinated_abort(self, tmp_path,
+                                                  eight_devices):
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=self._cfg(tmp_path, deadline_s=0.4))
+        train_steps(eng, 1)       # arm: stall detection needs one boundary
+        time.sleep(0.8)           # wedge the 'step loop'
+        with pytest.raises(CoordinatedAbort):
+            train_steps(eng, 1)   # next boundary: fleet-agreed ABORT
+        rep = eng.resilience_report()
+        assert rep["aborted"] is True
+        assert rep["heartbeat"]["counters"]["hangs_detected"] == 1
+        assert rep["coordination"]["last_reason"].startswith("hang")
+        assert "no step boundary" in rep["heartbeat"]["last_cause"]
+        # the liveness file is on disk for peers/operators
+        hb = json.load(open(tmp_path / "hb" / "heartbeat_0.json"))
+        assert hb["rank"] == 0 and hb["step"] >= 1
+        eng.shutdown()
+
+    def test_stuck_collective_classified_and_aborted(self, tmp_path,
+                                                     eight_devices):
+        """A host collective that outlives its deadline (injected
+        slow_collective riding the decision reduce) is detected WHILE in
+        flight, classified by name, and escalated."""
+        eng, *_ = ds.initialize(
+            model=TransformerLM(get_preset("tiny")),
+            config=self._cfg(tmp_path, collective_deadline_s=0.15,
+                             faults=[{"kind": "slow_collective",
+                                      "delay_s": 0.6}]))
+        with pytest.raises(CoordinatedAbort):
+            train_steps(eng, 2)
+        rep = eng.resilience_report()
+        assert rep["heartbeat"]["counters"]["stuck_collectives"] >= 1
+        assert "all_reduce_host" in rep["heartbeat"]["last_cause"]
+        eng.shutdown()
+
+    def test_startup_compile_does_not_trip_stall_deadline(self, tmp_path,
+                                                          eight_devices):
+        """XLA compilation before the first boundary routinely exceeds any
+        step deadline; the watchdog must stay disarmed until step 1."""
+        eng, *_ = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                                config=self._cfg(tmp_path, deadline_s=0.05))
+        time.sleep(0.3)           # 'compiling' — way past the deadline
+        assert eng._watchdog.hang_detected is False
+        losses = train_steps(eng, 1)
+        assert np.isfinite(losses[0])
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Monitor surfacing (resilience/* event stream)
+# ---------------------------------------------------------------------------
+
+class TestMonitorEvents:
+    def test_resilience_counters_flow_through_csv_monitor(self, tmp_path,
+                                                          eight_devices):
+        """ROADMAP item: resilience counters surface through the monitor
+        backends — `resilience/*` gauges land in the CSV backend at the
+        steps_per_print cadence."""
+        eng, *_ = ds.initialize(
+            model=TransformerLM(get_preset("tiny")),
+            config=make_config(
+                steps_per_print=1,
+                monitor_config={"csv_monitor": {
+                    "enabled": True, "output_path": str(tmp_path / "csv"),
+                    "job_name": "drill"}},
+                resilience={"faults": [{"kind": "nan_grads", "step": 1}]}))
+        train_steps(eng, 2)   # one skipped (injected), two committed
+        out = tmp_path / "csv" / "drill"
+        names = {p.name for p in out.iterdir()}
+        assert "resilience_skipped_steps.csv" in names
+        assert "resilience_guard_bad_steps_skipped.csv" in names
+        assert "resilience_comm_retries.csv" in names
+        rows = (out / "resilience_skipped_steps.csv").read_text().splitlines()
+        # header + one row per printed step; the last gauge shows the skip
+        assert rows[0].startswith("step,value")
+        assert float(rows[-1].split(",")[1]) == 1.0
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # Elastic agent decision loop
 # ---------------------------------------------------------------------------
 
@@ -368,6 +708,25 @@ class TestAgentDecisions:
         agent = ElasticAgent(self.ECFG, max_restarts=5, report_path=report)
         res = agent.run(spawn, chips=8)
         assert res.succeeded and res.restarts == 2
+
+    def test_hang_abort_always_respawns(self, tmp_path):
+        """Hang-triggered coordinated aborts are environmental, not
+        deterministic: identical steps + identical exit codes must still get
+        their respawn (the wedge was a lost host, not a poisoned batch)."""
+        report = str(tmp_path / "resilience_report.json")
+        calls = []
+
+        def spawn(chips, micro, idx):
+            json.dump({"aborted": True, "global_steps": 5,
+                       "coordination": {"last_reason":
+                                        "hang: stuck collective"}},
+                      open(report, "w"))
+            calls.append(idx)
+            return 17 if idx < 2 else 0
+
+        agent = ElasticAgent(self.ECFG, max_restarts=5, report_path=report)
+        res = agent.run(spawn, chips=8)
+        assert res.succeeded and res.restarts == 2  # no early give-up
 
     def test_restart_cap_stops_hot_loop(self):
         calls = []

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Chaos drill CLI: run a short training loop under a named fault scenario
+and exit nonzero if the recovery invariants fail.
+
+The CI-facing face of ``deepspeed_tpu/resilience``: each scenario wires the
+deterministic fault injector into a real (tiny, CPU-mesh) engine, drives the
+failure end to end, and asserts the invariant the resilience layer promises —
+no torn ``latest``, no silently-applied NaN step, no wedged-forever hang.
+
+    python tools/chaos_drill.py --list
+    python tools/chaos_drill.py --scenario nan-burst
+    python tools/chaos_drill.py --scenario preempt-mid-save
+    python tools/chaos_drill.py --scenario hung-collective
+
+Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
+The slow pytest wrappers live in ``tests/unit/test_chaos_drill.py`` under the
+``chaos`` marker (excluded from the tier-1 fast suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_engine(resilience, workdir):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    eng, *_ = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"fsdp": 8}, "steps_per_print": 100,
+                "resilience": {"enabled": True, **resilience}})
+    return eng
+
+
+def _train(eng, steps, seed=0, until_global_step=None):
+    """Run ``steps`` optimizer-step attempts — or, with ``until_global_step``,
+    loop until that many steps genuinely COMMITTED (skipped steps don't
+    advance ``global_steps``; recovery drills must outlast their skips)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    B = eng.train_micro_batch_size_per_gpu() * eng.topology.dp_world_size
+    losses = []
+
+    def done():
+        if until_global_step is not None:
+            return eng.global_steps >= until_global_step
+        return len(losses) >= steps
+
+    while not done():
+        loss = eng.forward({"input_ids": rng.integers(0, 256, (B, 16))})
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    return losses
+
+
+def _fresh_injector():
+    from deepspeed_tpu.resilience import set_injector
+
+    set_injector(None)
+
+
+# ---------------------------------------------------------------------------
+# scenarios: each returns (ok: bool, details: dict)
+# ---------------------------------------------------------------------------
+
+def scenario_preempt_mid_save(workdir):
+    """Async save staged, then the 'host is lost' before the manifest commit.
+    Invariant: after restart, load lands on the previous VERIFIED tag —
+    ``latest`` never names the torn stage."""
+    from deepspeed_tpu.resilience import FaultInjector, set_injector
+    from deepspeed_tpu.resilience.manager import STAGING_FILE, verify_tag_dir
+    from deepspeed_tpu.runtime.checkpoint import read_latest_tag
+
+    ckpt = os.path.join(workdir, "ckpt")
+    eng = _make_engine({"checkpoint": {"async_save": True}}, workdir)
+    _train(eng, 2)
+    eng.save_checkpoint(ckpt)
+    eng._primary_mgr.drain()                         # step-2 tag committed
+    _train(eng, 1)
+    set_injector(FaultInjector(
+        [{"kind": "io_error", "site": "async_commit"}]))
+    eng.save_checkpoint(ckpt)                        # stage killed pre-commit
+    eng._primary_mgr.drain(raise_on_error=False)
+    _fresh_injector()
+    eng.shutdown()                                   # 'host lost' here
+
+    eng2 = _make_engine({}, workdir)                 # the respawn
+    path, _ = eng2.load_checkpoint(ckpt)
+    staged = os.path.join(ckpt, "global_step3")
+    ok_prev, why = verify_tag_dir(os.path.join(ckpt, "global_step2"))
+    details = {"loaded": path, "resumed_step": eng2.global_steps,
+               "latest": read_latest_tag(ckpt),
+               "staged_sentinel": os.path.exists(
+                   os.path.join(staged, STAGING_FILE)),
+               "prev_tag_verified": ok_prev, "prev_tag_reason": why}
+    eng2.shutdown()
+    ok = (path is not None and path.endswith("global_step2")
+          and eng2.global_steps == 2
+          and details["latest"] == "global_step2"
+          and details["staged_sentinel"] and ok_prev)
+    return ok, details
+
+
+def scenario_nan_burst(workdir):
+    """A burst of poisoned-gradient steps inside the healing budget.
+    Invariant: every bad step skipped whole (params untouched), training
+    finishes the course with finite loss and the exact skip count."""
+    import numpy as np
+
+    eng = _make_engine({"max_consecutive_bad_steps": 4,
+                        "faults": [{"kind": "nan_grads", "step": 2,
+                                    "times": 2}]}, workdir)
+    losses = _train(eng, 0, until_global_step=5)
+    rep = eng.resilience_report()
+    details = {"skipped_steps": eng.skipped_steps,
+               "global_steps": eng.global_steps,
+               "final_loss": losses[-1],
+               "bad_steps_skipped": rep["guard"]["bad_steps_skipped"],
+               "aborted": rep["aborted"]}
+    eng.shutdown()
+    ok = (eng.skipped_steps == 2 and eng.global_steps == 5
+          and np.isfinite(losses[-1]) and not rep["aborted"])
+    return ok, details
+
+
+def scenario_hung_collective(workdir):
+    """A host collective wedges past its deadline. Invariant: the watchdog
+    detects it WHILE in flight, names the collective, and the fleet-agreed
+    ABORT reaches the step loop (the elastic agent's respawn signal) instead
+    of the process hanging forever."""
+    from deepspeed_tpu.resilience import CoordinatedAbort
+
+    hb_dir = os.path.join(workdir, "heartbeats")
+    eng = _make_engine({
+        "heartbeat": {"enabled": True, "dir": hb_dir, "interval_s": 0.05,
+                      "poll_s": 0.05, "deadline_s": 30.0,
+                      "collective_deadline_s": 0.15},
+        "faults": [{"kind": "slow_collective", "delay_s": 0.6}]}, workdir)
+    aborted = False
+    try:
+        _train(eng, 3)
+    except CoordinatedAbort:
+        aborted = True
+    rep = eng.resilience_report()
+    details = {"aborted": aborted,
+               "stuck_collectives":
+                   rep["heartbeat"]["counters"]["stuck_collectives"],
+               "last_cause": rep["heartbeat"]["last_cause"],
+               "heartbeat_file": os.path.exists(
+                   os.path.join(hb_dir, "heartbeat_0.json"))}
+    eng.shutdown()
+    ok = (aborted and details["stuck_collectives"] >= 1
+          and "all_reduce_host" in details["last_cause"]
+          and details["heartbeat_file"])
+    return ok, details
+
+
+SCENARIOS = {
+    "preempt-mid-save": scenario_preempt_mid_save,
+    "nan-burst": scenario_nan_burst,
+    "hung-collective": scenario_hung_collective,
+}
+
+
+def run_scenario(name: str, workdir=None) -> dict:
+    """Run one drill; returns the verdict record (also usable from tests)."""
+    if name not in SCENARIOS:
+        raise SystemExit(f"unknown scenario {name!r} "
+                         f"(have: {sorted(SCENARIOS)})")
+    _fresh_injector()
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix=f"chaos_{name.replace('-', '_')}_")
+    t0 = time.time()
+    try:
+        ok, details = SCENARIOS[name](workdir)
+    finally:
+        _fresh_injector()
+        from deepspeed_tpu import comm
+
+        comm.set_retry_policy(None)
+    return {"scenario": name, "ok": ok, "seconds": round(time.time() - t0, 2),
+            "workdir": workdir, "details": details}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", help="which drill to run")
+    ap.add_argument("--all", action="store_true", help="run every scenario")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, fn in SCENARIOS.items():
+            print(f"{name}: {fn.__doc__.splitlines()[0]}")
+        return 0
+    names = list(SCENARIOS) if args.all else (
+        [args.scenario] if args.scenario else None)
+    if not names:
+        ap.error("pass --scenario NAME, --all, or --list")
+    rc = 0
+    for name in names:
+        verdict = run_scenario(name, workdir=args.workdir)
+        print(json.dumps(verdict, indent=2, default=str))
+        if not verdict["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
