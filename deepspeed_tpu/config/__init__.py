@@ -10,6 +10,7 @@ from deepspeed_tpu.config.config import (  # noqa: F401
     OptimizerConfig,
     PipelineConfig,
     SchedulerConfig,
+    ServingConfig,
     ZeroConfig,
     ZeroStageEnum,
     from_config,

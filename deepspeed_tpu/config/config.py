@@ -493,6 +493,57 @@ class HeartbeatConfig(DSTpuConfigModel):
     exit_code: int = 47
 
 
+class ServingConfig(DSTpuConfigModel):
+    """``serving`` section: the request-lifecycle layer above
+    ``InferenceEngineV2`` (``deepspeed_tpu/serving``) — bounded admission,
+    per-request deadlines, watermark load shedding, degraded-mode capacity
+    reduction, and SIGTERM graceful drain.
+
+    Watermark semantics: admission projects each request's WORST-CASE KV
+    demand (prompt + max_new_tokens) and admits while projected pool use
+    stays under ``kv_high_watermark``; if live occupancy still crosses it
+    (or a ``shed_storm`` fault forces the path), in-flight lowest-priority/
+    newest requests are shed until occupancy returns under
+    ``kv_low_watermark``. DEGRADED health multiplies the admission caps by
+    ``degraded_capacity_factor`` until the failure window clears."""
+
+    enabled: bool = False
+    max_queue_depth: int = 64
+    # queued requests above this are shed (None = max_queue_depth; the gap
+    # between the two is the burst buffer that sheds instead of rejecting)
+    queue_high_watermark: Optional[int] = None
+    max_active_requests: Optional[int] = None  # None = engine max_sequences
+    default_max_new_tokens: int = 128
+    default_deadline_s: Optional[float] = None   # None = no deadline
+    retry_after_s: float = 1.0        # backoff hint carried by ShedError
+    prefill_chunk: int = 256          # prompt tokens fed per serving step
+    eos_token_id: Optional[int] = None
+    kv_high_watermark: float = 0.90
+    kv_low_watermark: float = 0.75
+    failure_window: int = 32          # sliding step-outcome window length
+    degrade_failure_ratio: float = 0.25   # enter DEGRADED at this ratio
+    degraded_capacity_factor: float = 0.5
+    drain_timeout_s: float = 30.0
+    monitor_interval: int = 10        # serving steps between monitor writes
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not (0.0 < self.kv_low_watermark <= self.kv_high_watermark
+                <= 1.0):
+            raise ValueError("serving: need 0 < kv_low_watermark <= "
+                             "kv_high_watermark <= 1")
+        if not (0.0 < self.degraded_capacity_factor <= 1.0):
+            raise ValueError("serving.degraded_capacity_factor must be in "
+                             "(0, 1]")
+        if not (0.0 < self.degrade_failure_ratio <= 1.0):
+            raise ValueError("serving.degrade_failure_ratio must be in "
+                             "(0, 1]")
+        if self.prefill_chunk < 1 or self.max_queue_depth < 1:
+            raise ValueError("serving: prefill_chunk and max_queue_depth "
+                             "must be >= 1")
+        return self
+
+
 class ResilienceConfig(DSTpuConfigModel):
     """``resilience`` section: the closed-loop fault-tolerance layer
     (``deepspeed_tpu/resilience``) — step guard, retries, checkpoint
@@ -540,6 +591,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)

@@ -8,7 +8,8 @@ re-rendezvouses at a smaller world size on failure, resuming from the latest
 """
 
 from deepspeed_tpu.elasticity.agent import (  # noqa: F401
-    AgentResult, ElasticAgent, subprocess_spawn,
+    AgentResult, CohortSupervisor, ElasticAgent, subprocess_spawn,
+    supervised_subprocess_spawn,
 )
 from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
     compute_elastic_config, get_compatible_chip_counts,

@@ -197,6 +197,96 @@ class ElasticAgent:
         return AgentResult(False, history)
 
 
+class CohortSupervisor:
+    """Agent-side heartbeat supervision: kill a wedged cohort from OUTSIDE.
+
+    The in-process :class:`~deepspeed_tpu.resilience.heartbeat.HangWatchdog`
+    handles stalls the process can still observe (``on_hang=abort`` rides
+    the next step boundary). When the process is wedged hard enough that no
+    Python thread runs — a livelocked runtime, a SIGSTOP, a kernel-stuck
+    collective — the watchdog itself is dead and only the heartbeat files of
+    PR 2 remain visible. This supervisor watches those
+    ``heartbeat_{rank}.json`` files from the agent process: once the first
+    heartbeat of THIS incarnation appears (startup compile stays exempt,
+    mirroring the watchdog's arming rule — beats left behind by a previous
+    cohort are ignored, so a respawn is not killed off its predecessor's
+    stale files), a cohort whose NEWEST heartbeat mtime goes stale past
+    ``deadline_s`` is sent SIGTERM, then SIGKILL after ``grace_s`` — the
+    spawn returns nonzero and the agent's ordinary respawn path takes
+    over.
+    """
+
+    def __init__(self, hb_dir: str, deadline_s: float = 300.0,
+                 poll_s: Optional[float] = None, grace_s: float = 10.0):
+        self.hb_dir = hb_dir
+        self.deadline_s = float(deadline_s)
+        self.poll_s = float(poll_s) if poll_s else max(
+            0.05, self.deadline_s / 10.0)
+        self.grace_s = float(grace_s)
+        self.kills = 0
+        self.last_cause = ""
+
+    def _newest_beat(self) -> Optional[float]:
+        """mtime of the freshest heartbeat file, or None before the cohort
+        wrote any (not armed yet)."""
+        newest = None
+        try:
+            names = os.listdir(self.hb_dir)
+        except OSError:
+            return None
+        for name in names:
+            if not (name.startswith("heartbeat_") and name.endswith(".json")):
+                continue
+            try:
+                mt = os.path.getmtime(os.path.join(self.hb_dir, name))
+            except OSError:
+                continue
+            newest = mt if newest is None else max(newest, mt)
+        return newest
+
+    def _kill(self, proc) -> None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=self.grace_s)
+        except Exception:
+            logger.error("cohort supervisor: SIGTERM ignored; escalating "
+                         "to SIGKILL")
+            proc.kill()
+
+    def watch(self, proc) -> int:
+        """Block until ``proc`` (a ``subprocess.Popen``) exits or is killed
+        for heartbeat staleness; returns the exit code."""
+        # Arm only on a beat written by THIS cohort: the baseline is the
+        # newest mtime at watch() entry (the previous incarnation's files —
+        # by construction already stale after a hang-kill — must not
+        # trigger a kill->respawn loop). Staleness is then measured from
+        # when WE last observed a new beat, all on the local clock, so a
+        # skewed file-server clock on shared storage can neither arm the
+        # supervisor early nor park it forever.
+        baseline = self._newest_beat()
+        last_seen, observed_at = baseline, None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            newest = self._newest_beat()
+            if newest is not None and (last_seen is None
+                                       or newest > last_seen):
+                last_seen, observed_at = newest, time.time()
+            if observed_at is not None:
+                age = time.time() - observed_at
+                if age > self.deadline_s:
+                    self.kills += 1
+                    self.last_cause = (
+                        f"stale cohort heartbeats: last new beat observed "
+                        f"{age:.1f}s ago (deadline {self.deadline_s}s)")
+                    logger.error(f"cohort supervisor: {self.last_cause}; "
+                                 f"killing pid {proc.pid}")
+                    self._kill(proc)
+                    return proc.wait()
+            time.sleep(self.poll_s)
+
+
 def subprocess_spawn(script: str, script_args: List[str], base_env: Dict[str, str],
                      checkpoint_dir: str) -> Callable[[int, int, int], int]:
     """The launcher-facing spawn: one local process per cohort, world size and
@@ -207,14 +297,53 @@ def subprocess_spawn(script: str, script_args: List[str], base_env: Dict[str, st
     import sys
 
     def spawn(chips: int, micro_batch: int, restart_idx: int) -> int:
-        env = dict(base_env)
-        env.update({
-            "DSTPU_ELASTIC_CHIPS": str(chips),
-            "DSTPU_ELASTIC_MICRO": str(micro_batch),
-            "DSTPU_RESTART_COUNT": str(restart_idx),
-            "DSTPU_CHECKPOINT_DIR": checkpoint_dir,
-        })
-        return subprocess.call([sys.executable, script] + list(script_args),
-                               env=env)
+        return subprocess.call(
+            [sys.executable, script] + list(script_args),
+            env=_cohort_env(base_env, chips, micro_batch, restart_idx,
+                            checkpoint_dir))
 
     return spawn
+
+
+def _cohort_env(base_env: Dict[str, str], chips: int, micro_batch: int,
+                restart_idx: int, checkpoint_dir: str) -> Dict[str, str]:
+    """The env contract every cohort spawn hands the trainer — one place,
+    so supervised and unsupervised spawns cannot drift apart."""
+    env = dict(base_env)
+    env.update({
+        "DSTPU_ELASTIC_CHIPS": str(chips),
+        "DSTPU_ELASTIC_MICRO": str(micro_batch),
+        "DSTPU_RESTART_COUNT": str(restart_idx),
+        "DSTPU_CHECKPOINT_DIR": checkpoint_dir,
+    })
+    return env
+
+
+def supervised_subprocess_spawn(
+        script: str, script_args: List[str], base_env: Dict[str, str],
+        checkpoint_dir: str, hb_dir: Optional[str] = None,
+        deadline_s: float = 300.0, poll_s: Optional[float] = None,
+        grace_s: float = 10.0,
+        ) -> Tuple[Callable[[int, int, int], int], CohortSupervisor]:
+    """:func:`subprocess_spawn` with a :class:`CohortSupervisor` riding
+    along: the cohort runs under ``Popen`` and the returned spawn blocks in
+    ``supervisor.watch``, so a cohort whose heartbeats go stale is killed
+    from outside and the agent sees an ordinary nonzero exit. ``hb_dir``
+    defaults to the same ``<checkpoint_dir>/heartbeats`` the engine's
+    heartbeat config defaults to. Returns ``(spawn, supervisor)`` — the
+    supervisor carries ``kills`` / ``last_cause`` for the post-mortem."""
+    import subprocess
+    import sys
+
+    hb_dir = hb_dir or os.path.join(checkpoint_dir, "heartbeats")
+    supervisor = CohortSupervisor(hb_dir, deadline_s=deadline_s,
+                                  poll_s=poll_s, grace_s=grace_s)
+
+    def spawn(chips: int, micro_batch: int, restart_idx: int) -> int:
+        proc = subprocess.Popen(
+            [sys.executable, script] + list(script_args),
+            env=_cohort_env(base_env, chips, micro_batch, restart_idx,
+                            checkpoint_dir))
+        return supervisor.watch(proc)
+
+    return spawn, supervisor

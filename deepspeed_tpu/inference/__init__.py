@@ -7,4 +7,5 @@ allocator, ragged step).
 
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2  # noqa: F401
-from deepspeed_tpu.inference.ragged import BlockedAllocator, SequenceManager  # noqa: F401
+from deepspeed_tpu.inference.ragged import (BlockedAllocator, CapacityError,  # noqa: F401
+                                            SequenceManager)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.quant import QUANT_LEAVES
-from deepspeed_tpu.inference.ragged import SequenceManager
+from deepspeed_tpu.inference.ragged import CapacityError, SequenceManager
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -300,9 +300,8 @@ class InferenceEngineV2:
             raise ValueError("decode_batch needs the packed paged engine")
         if not self.state.can_schedule_batch(batch_uids,
                                              [steps] * len(batch_uids)):
-            raise RuntimeError(
-                f"cannot schedule uids={list(batch_uids)} (+{steps} each: "
-                "per-sequence limit or aggregate KV demand exceeded)")
+            raise CapacityError(batch_uids, [steps] * len(batch_uids),
+                                "decode_batch")
         descs = [self.state.schedule(uid, steps) for uid in batch_uids]
         B = len(descs)
         bpad = max(8, 1 << (B - 1).bit_length())  # bounded jit cache as B drains
@@ -370,9 +369,8 @@ class InferenceEngineV2:
         # completion, so timing must not be measured from put() entry
         if not self.state.can_schedule_batch(batch_uids,
                                              [len(c) for c in chunks]):
-            raise RuntimeError(
-                f"cannot schedule uids={list(batch_uids)} "
-                f"(+{[len(c) for c in chunks]} tokens jointly)")
+            raise CapacityError(batch_uids, [len(c) for c in chunks],
+                                "whole-prompt prefill")
         longest = max(len(c) for c in chunks)
         T_pad0 = max(_MIN_TILE, 1 << (longest - 1).bit_length())
         group = max(1, self.PREFILL_BATCH_TOKENS // T_pad0)
@@ -440,9 +438,8 @@ class InferenceEngineV2:
             if any(len(c) > cap for c in chunks) and \
                     not self.state.can_schedule_batch(
                         batch_uids, [len(c) for c in chunks]):
-                raise RuntimeError(
-                    f"cannot schedule uids={list(batch_uids)} "
-                    f"(+{[len(c) for c in chunks]} tokens jointly)")
+                raise CapacityError(batch_uids, [len(c) for c in chunks],
+                                    "joint chunked prefill")
             while any(len(c) > cap for c in chunks):
                 sel = [(u, c[:cap]) for u, c in zip(batch_uids, chunks)
                        if len(c) > cap]
@@ -454,10 +451,7 @@ class InferenceEngineV2:
                 t_put = time.perf_counter()
         if not self.state.can_schedule_batch(batch_uids,
                                              [len(c) for c in chunks]):
-            raise RuntimeError(
-                f"cannot schedule uids={list(batch_uids)} "
-                f"(+{[len(c) for c in chunks]} tokens: per-sequence limit or "
-                "aggregate KV demand exceeded)")
+            raise CapacityError(batch_uids, [len(c) for c in chunks])
         descs = [self.state.schedule(uid, len(toks))
                  for uid, toks in zip(batch_uids, chunks)]
 

@@ -12,9 +12,30 @@ paged attention kernel).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+class CapacityError(RuntimeError):
+    """Engine overload: the requested tokens do not fit the KV pool / slot
+    budget right now. Subclasses ``RuntimeError`` so pre-existing callers that
+    catch the old bare raise keep working, but carries the machine-readable
+    demand so a serving layer can tell overload (shed + retry later) from a
+    bug (crash loudly): ``uids`` are the sequences that could not be
+    scheduled jointly and ``token_demand`` the per-uid token counts asked
+    for."""
+
+    def __init__(self, uids: Sequence[int], token_demand: Sequence[int],
+                 detail: str = ""):
+        self.uids = list(uids)
+        self.token_demand = [int(n) for n in token_demand]
+        msg = (f"cannot schedule uids={self.uids} "
+               f"(+{self.token_demand} tokens: per-sequence limit or "
+               "aggregate KV demand exceeded)")
+        if detail:
+            msg = f"{msg}: {detail}"
+        super().__init__(msg)
 
 
 class BlockedAllocator:

@@ -38,7 +38,8 @@ which the elastic agent consumes to decide respawn vs. give-up.
 
 from deepspeed_tpu.resilience.coordinator import (ABORT, CONTINUE, SAVE,
                                                   CoordinatedAbort,
-                                                  ResilienceCoordinator)
+                                                  ResilienceCoordinator,
+                                                  kv_store_max_reduce)
 from deepspeed_tpu.resilience.faults import (FaultInjector, InjectedCrash,
                                              InjectedIOError, get_injector,
                                              set_injector)
@@ -64,6 +65,7 @@ __all__ = [
     "StepGuard",
     "TooManyBadSteps",
     "get_injector",
+    "kv_store_max_reduce",
     "set_injector",
     "retry_call",
 ]

@@ -35,6 +35,7 @@ real cross-process collective is used.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -42,7 +43,8 @@ from typing import Callable, Dict, Optional
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["CONTINUE", "SAVE", "ABORT", "DECISION_NAMES",
-           "CoordinatedAbort", "ResilienceCoordinator"]
+           "CoordinatedAbort", "ResilienceCoordinator",
+           "kv_store_max_reduce"]
 
 CONTINUE, SAVE, ABORT = 0, 1, 2
 DECISION_NAMES = {CONTINUE: "CONTINUE", SAVE: "SAVE", ABORT: "ABORT"}
@@ -51,6 +53,54 @@ DECISION_NAMES = {CONTINUE: "CONTINUE", SAVE: "SAVE", ABORT: "ABORT"}
 class CoordinatedAbort(RuntimeError):
     """The fleet agreed to abort this incarnation (hang, peer failure, or a
     step-guard budget spent somewhere); the elastic agent should respawn."""
+
+
+def kv_store_max_reduce(num_processes: Optional[int] = None,
+                        rank: Optional[int] = None,
+                        timeout_ms: int = 60_000,
+                        namespace: str = "resilience/decide"
+                        ) -> Callable[[int], int]:
+    """A cross-process max-reduce over the ``jax.distributed`` coordination
+    service's key-value store — a ``reduce_fn`` for
+    :class:`ResilienceCoordinator` that needs only the rendezvous plane,
+    not device collectives. That matters in two places: fleets whose
+    backend cannot run multi-process device computations (the CPU backend),
+    and drills that want the REAL cross-process path without standing up a
+    device mesh. Each call publishes this process's code under a
+    monotonically-numbered round key and blocking-reads every peer's, so
+    successive boundaries can never read a stale round.
+
+    Requires ``jax.distributed.initialize`` to have run. ``num_processes``/
+    ``rank`` default to the initialized world's.
+    """
+    import jax
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError("kv_store_max_reduce needs jax.distributed to be "
+                           "initialized (no coordination client)")
+    n = int(num_processes) if num_processes else jax.process_count()
+    r = int(rank) if rank is not None else jax.process_index()
+    rounds = itertools.count()
+
+    def reduce(code: int) -> int:
+        i = next(rounds)
+        client.key_value_set(f"{namespace}/{i}/{r}", str(int(code)))
+        agreed = max(int(client.blocking_key_value_get(
+            f"{namespace}/{i}/{p}", timeout_ms)) for p in range(n))
+        # GC this rank's round i-2 key so a long run does not grow the
+        # coordinator's store without bound. Safe: reaching round i means
+        # every peer is in round >= i-1, hence finished ALL round i-2
+        # reads (the blocking gets above are the round barrier).
+        if i >= 2:
+            try:
+                client.key_value_delete(f"{namespace}/{i - 2}/{r}")
+            except Exception:
+                pass  # older jaxlib without delete: bounded by run length
+        return agreed
+
+    return reduce
 
 
 class ResilienceCoordinator:

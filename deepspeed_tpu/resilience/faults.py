@@ -20,6 +20,13 @@ Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
   corrupts files after the save so verification must reject the tag.
 * ``"checkpoint_io"`` — checkpoint IO entry; ``io_error`` raises for the first
   ``times`` calls (retry testing).
+* serving sites (``deepspeed_tpu/serving``, drilled by ``tools/serve_drill.py``
+  the way ``tools/chaos_drill.py`` drills training): ``slow_decode`` sleeps at
+  the batcher's decode dispatch, ``cache_io_error`` raises
+  :class:`InjectedIOError` at the engine step (a lost KV-cache read/write),
+  ``decode_nan`` poisons a step's returned logits so the batcher's failure
+  window and degraded mode are exercised, and ``shed_storm`` forces the
+  watermark-shedding path for ``times`` consecutive serving steps.
 """
 
 from __future__ import annotations
@@ -69,7 +76,9 @@ class FaultSpec:
     site: Optional[str] = None  # io_error/crash: restrict to one IO hook site
 
     KINDS = ("crash", "nan_grads", "slow_collective", "failed_collective",
-             "torn_checkpoint", "io_error")
+             "torn_checkpoint", "io_error",
+             # serving sites (ContinuousBatcher hooks)
+             "slow_decode", "decode_nan", "shed_storm", "cache_io_error")
 
     def __post_init__(self):
         if self.kind not in self.KINDS:
@@ -166,6 +175,44 @@ class FaultInjector:
                 if spec.hard:
                     os._exit(spec.exit_code)
                 raise InjectedCrash(f"injected crash at checkpoint IO ({what})")
+
+    # ---- serving-site faults ----------------------------------------------
+    def on_serving_step(self, site: str) -> None:
+        """Hook at the batcher's engine dispatch (``site``: ``prefill`` |
+        ``decode``). ``slow_decode`` injects latency at the decode site (step
+        deadline / p99 drills); ``cache_io_error`` raises at any serving site
+        (or the one named by ``spec.site``) — the batcher must absorb it as a
+        failed step, not lose requests."""
+        for spec in self.faults:
+            if spec.kind == "slow_decode" and site == "decode" \
+                    and self._take(spec):
+                self._record(spec, f"serving:{site}")
+                time.sleep(spec.delay_s)
+            elif spec.kind == "cache_io_error" \
+                    and spec.site in (None, site) and self._take(spec):
+                self._record(spec, f"serving:{site}")
+                raise InjectedIOError(
+                    f"injected KV-cache IO failure ({site})")
+
+    def maybe_poison_logits(self, logits):
+        """Return ``logits`` poisoned to NaN when a ``decode_nan`` fault
+        matches (serving analog of :meth:`maybe_poison_grads`)."""
+        for spec in self.faults:
+            if spec.kind == "decode_nan" and self._take(spec):
+                self._record(spec, "serving:decode")
+                import numpy as np
+
+                return np.full_like(np.asarray(logits, np.float32), np.nan)
+        return logits
+
+    def shed_forced(self) -> bool:
+        """True while a ``shed_storm`` fault has occurrences left: the
+        batcher treats its load watermarks as exceeded this step."""
+        for spec in self.faults:
+            if spec.kind == "shed_storm" and self._take(spec):
+                self._record(spec, "serving:shed")
+                return True
+        return False
 
     def maybe_tear_checkpoint(self, tag_dir: str, step: int) -> bool:
         """After a save: damage the newest tag so verification must reject it.

@@ -1,0 +1,41 @@
+"""Serving resilience: the request-lifecycle layer above
+:class:`~deepspeed_tpu.inference.InferenceEngineV2`.
+
+The training side of the resilience stack (PRs 1–2) made the *trainer*
+preemption-safe; this package does the same for the *serving* path, pairing
+the continuous-batching engine with the hardened request surface the
+reference stack gets from FastGen/MII scheduling + backpressure:
+
+* :mod:`~deepspeed_tpu.serving.request` — request states, the typed
+  :class:`ShedError` backpressure signal (retryable overload vs terminal),
+  and the per-request lifecycle record;
+* :mod:`~deepspeed_tpu.serving.manager` — :class:`RequestManager`: bounded
+  admission queue, per-request deadlines with cancellation and KV/slot
+  reclamation through ``engine.flush``, and the terminal ledger that makes
+  "no request silently lost" checkable;
+* :mod:`~deepspeed_tpu.serving.batcher` — :class:`ContinuousBatcher`: the
+  serving step loop (admission → chunked prefill → decode) with KV/queue
+  watermark load shedding, STARTING/READY/DEGRADED/DRAINING health from a
+  sliding failure window, SIGTERM graceful drain, ``serving/*`` monitor
+  events, and ``serving_report()``.
+
+Chaos-drilled by ``tools/serve_drill.py`` (deadline-storm,
+shed-under-KV-pressure, SIGTERM-drain) through the same deterministic
+fault injector that drills training (``resilience/faults.py`` serving
+sites: ``slow_decode``, ``decode_nan``, ``shed_storm``,
+``cache_io_error``).
+"""
+
+from deepspeed_tpu.serving.batcher import (DEGRADED, DRAINING, READY,
+                                           STARTING, ContinuousBatcher)
+from deepspeed_tpu.serving.manager import RequestManager
+from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
+                                           EXPIRED, PREFILLING, QUEUED, SHED,
+                                           TERMINAL_STATES, ServeRequest,
+                                           ShedError)
+
+__all__ = [
+    "CANCELLED", "COMPLETED", "DECODING", "DEGRADED", "DRAINING", "EXPIRED",
+    "PREFILLING", "QUEUED", "READY", "SHED", "STARTING", "TERMINAL_STATES",
+    "ContinuousBatcher", "RequestManager", "ServeRequest", "ShedError",
+]

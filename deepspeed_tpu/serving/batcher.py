@@ -1,0 +1,461 @@
+"""Continuous batching with admission control, backpressure, and drain.
+
+:class:`ContinuousBatcher` composes the pieces into one serving step
+(`step()`), the FastGen/MII scheduling loop shape on top of
+``InferenceEngineV2.put``:
+
+1. **deadline sweep** — queued and in-flight requests past their deadline
+   are expired; in-flight expiry releases every KV block through
+   ``engine.flush`` (a prompt half-way through chunked prefill must not
+   leak pool blocks).
+2. **load shedding** — when aggregate KV occupancy or queue depth crosses
+   the configured watermarks (or a ``shed_storm`` fault forces it), the
+   lowest-priority / newest requests are shed with a typed
+   :class:`~deepspeed_tpu.serving.request.ShedError` — *before* the engine
+   step, so ``put()`` never throws mid-batch on a planned schedule.
+3. **admission** — queued requests are admitted oldest-first while the
+   projected KV demand (prompt + max_new_tokens) stays under the admission
+   watermark and the active-set cap. In DEGRADED health both caps shrink by
+   ``degraded_capacity_factor`` (capacity reduction, not active eviction).
+4. **one engine step** — decode tokens (1-token chunks) and the next
+   prefill chunk of every prefilling request ride ONE ``put()`` batch; the
+   engine's packed ragged layout does the rest. Greedy argmax on the
+   returned chunk-end logits advances each sequence.
+
+Health is STARTING → READY, with a sliding window of step outcomes driving
+READY ⇄ DEGRADED, and SIGTERM (or ``begin_drain``) entering DRAINING:
+admission closes, queued requests are shed retryably, in-flight sequences
+finish (or are abandoned at ``drain_timeout_s``), then the loop exits —
+the serving analog of the training engine's preemption-safe shutdown.
+
+Counters, queue/KV occupancy, and p50/p99 step latency stream through the
+monitor backends under ``serving/*``; :meth:`serving_report` mirrors the
+training engine's ``resilience_report()``.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.ragged import CapacityError
+from deepspeed_tpu.resilience.faults import InjectedIOError, get_injector
+from deepspeed_tpu.serving.manager import RequestManager
+from deepspeed_tpu.serving.request import DECODING, PREFILLING, ServeRequest
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["STARTING", "READY", "DEGRADED", "DRAINING", "ContinuousBatcher"]
+
+STARTING, READY, DEGRADED, DRAINING = ("starting", "ready", "degraded",
+                                       "draining")
+
+
+class ContinuousBatcher:
+    def __init__(self, engine, config=None, monitor=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 manager: Optional[RequestManager] = None):
+        """``engine`` is an :class:`InferenceEngineV2` (packed+paged);
+        ``config`` a :class:`~deepspeed_tpu.config.config.ServingConfig`
+        (None = defaults); ``monitor`` an optional
+        :class:`~deepspeed_tpu.monitor.MonitorMaster` for the ``serving/*``
+        stream. ``clock`` is injectable so deadline tests are
+        deterministic."""
+        if not getattr(engine, "packed", False):
+            raise ValueError("ContinuousBatcher needs the packed paged "
+                             "engine (InferenceEngineV2(packed=True))")
+        from deepspeed_tpu.config.config import ServingConfig
+
+        self.engine = engine
+        self.cfg = config if config is not None else ServingConfig()
+        self.monitor = monitor
+        self.clock = clock
+        self.manager = manager if manager is not None else RequestManager(
+            max_queue_depth=self.cfg.max_queue_depth,
+            default_max_new_tokens=self.cfg.default_max_new_tokens,
+            default_deadline_s=self.cfg.default_deadline_s,
+            retry_after_s=self.cfg.retry_after_s,
+            clock=clock)
+        self.manager.release_fn = lambda uids: self.engine.flush(uids)
+        self.health = STARTING
+        self.drained = False
+        self.drain_reason = ""
+        self.steps = 0
+        self._drain_requested = threading.Event()
+        self._prev_sigterm = None
+        # sliding window of step outcomes (True = failed) drives DEGRADED
+        self._failures: Deque[bool] = deque(maxlen=self.cfg.failure_window)
+        self._latencies_ms: Deque[float] = deque(maxlen=256)
+        self.counters: Dict[str, int] = {
+            "engine_steps": 0, "idle_steps": 0, "step_failures": 0,
+            "decode_tokens": 0, "prefill_tokens": 0, "degraded_entries": 0,
+        }
+
+    @classmethod
+    def from_deepspeed_config(cls, engine, config, monitor=None, **kw):
+        """Build from a full :class:`~deepspeed_tpu.config.config.
+        DeepSpeedTpuConfig` — the consumer of its ``serving`` section.
+        Requires ``serving.enabled`` so a config that merely carries the
+        block cannot silently stand up a server."""
+        serving = getattr(config, "serving", None)
+        if serving is None or not serving.enabled:
+            raise ValueError(
+                "serving.enabled must be true to build a ContinuousBatcher "
+                "from a DeepSpeedTpuConfig (or pass a ServingConfig "
+                "directly)")
+        return cls(engine, serving, monitor=monitor, **kw)
+
+    # ------------------------------------------------------------------
+    # intake passthrough
+    # ------------------------------------------------------------------
+    def submit(self, prompt, **kw) -> int:
+        return self.manager.submit(prompt, **kw)
+
+    # ------------------------------------------------------------------
+    # capacity accounting
+    # ------------------------------------------------------------------
+    @property
+    def num_blocks(self) -> int:
+        return self.engine.state.allocator.num_blocks
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.engine.state.allocator.free_blocks
+
+    @property
+    def kv_occupancy(self) -> float:
+        return self.used_blocks / max(1, self.num_blocks)
+
+    def _blocks_for(self, tokens: int) -> int:
+        bs = self.engine.state.allocator.block_size
+        return -(-int(tokens) // bs)
+
+    def _capacity_factor(self) -> float:
+        return (self.cfg.degraded_capacity_factor
+                if self.health == DEGRADED else 1.0)
+
+    def _max_active_eff(self) -> int:
+        cap = self.cfg.max_active_requests or self.engine.state.max_sequences
+        cap = min(cap, self.engine.state.max_sequences)
+        return max(1, int(cap * self._capacity_factor()))
+
+    def _queue_high_eff(self) -> int:
+        high = (self.cfg.queue_high_watermark
+                if self.cfg.queue_high_watermark is not None
+                else self.cfg.max_queue_depth)
+        return max(1, int(high * self._capacity_factor()))
+
+    # ------------------------------------------------------------------
+    # phases of one step
+    # ------------------------------------------------------------------
+    def _shed_over_watermarks(self, forced: bool) -> None:
+        mgr = self.manager
+        if forced:
+            # shed_storm drill: drop the whole queue this step, retryably
+            for req in mgr.queued_by_shed_order():
+                mgr.shed(req, "shed_storm")
+        overflow = mgr.queue_depth - self._queue_high_eff()
+        if overflow > 0:
+            for req in mgr.queued_by_shed_order()[:overflow]:
+                mgr.shed(req, "queue_pressure")
+        if forced or self.kv_occupancy > self.cfg.kv_high_watermark:
+            # free real blocks: evict in-flight lowest-priority/newest until
+            # under the low watermark, but never the last survivor — the
+            # oldest/highest-priority request must keep making progress
+            victims = mgr.active_by_shed_order()
+            while len(victims) > 1 \
+                    and self.kv_occupancy > self.cfg.kv_low_watermark:
+                mgr.shed(victims.pop(0), "kv_pressure")
+
+    def _projected_blocks(self) -> int:
+        """Worst-case pool demand of everything already admitted: blocks
+        held now plus what each active request may still need to reach
+        prompt + max_new_tokens. Admission budgets against THIS, not live
+        occupancy — otherwise several admissions in one sweep would each
+        see the same pre-admission pool and jointly overcommit it, only to
+        strand each other mid-generation under kv_pressure sheds."""
+        seqs = self.engine.state.sequences
+        proj = self.used_blocks
+        for r in self.manager.active.values():
+            held = len(seqs[r.uid].blocks) if r.uid in seqs else 0
+            proj += max(0, self._blocks_for(r.total_token_demand) - held)
+        return proj
+
+    def _admit(self) -> None:
+        mgr = self.manager
+        budget = self.num_blocks * self.cfg.kv_high_watermark \
+            * self._capacity_factor()
+        proj = self._projected_blocks()
+        while mgr.queue and len(mgr.active) < self._max_active_eff():
+            req = mgr.queue[0]
+            need = self._blocks_for(req.total_token_demand)
+            if req.total_token_demand > self.engine.max_seq_len \
+                    or need > self.num_blocks * self.cfg.kv_high_watermark:
+                # can never fit, at any load — terminal, not retryable
+                mgr.shed(req, "oversize", retryable=False)
+                continue
+            if proj + need > budget:
+                if not mgr.active:
+                    # nothing in flight will ever free blocks for this head
+                    # (a DEGRADED budget squeeze, or an externally occupied
+                    # pool): shed retryably instead of leaving the loop to
+                    # spin forever on an unadmittable head
+                    mgr.shed(req, "capacity")
+                    continue
+                break          # FIFO head-of-line: don't starve big requests
+            mgr.admit(req)
+            proj += need
+
+    def _plan(self) -> List[ServeRequest]:
+        """The step's participants: every decoding request (1 token) and
+        every prefilling request (next prompt chunk), trimmed by the joint
+        schedulability check — over-demand sheds lowest-priority/newest
+        BEFORE put() so the engine never throws mid-batch."""
+        chunk = self.cfg.prefill_chunk
+        batch = self.manager.decoding() + self.manager.prefilling()
+        if not batch:
+            return []
+
+        def demand(r):
+            return 1 if r.state == DECODING else min(
+                chunk, r.prompt_len - r.prefilled)
+
+        while batch and not self.engine.state.can_schedule_batch(
+                [r.uid for r in batch], [demand(r) for r in batch]):
+            victim = max(batch, key=lambda r: (
+                -r.priority, r.submitted_at))  # lowest priority, then newest
+            batch.remove(victim)
+            self.manager.shed(victim, "capacity")
+        return batch
+
+    def _advance(self, req: ServeRequest, fed: int, logits) -> None:
+        """Commit one put()'s outcome for one request. The argmax of this
+        step's logits IS a generated token, counted and completion-checked
+        immediately — a request's last token never rides an extra decode
+        step (whose logits would be discarded) just to be recorded."""
+        if req.state == PREFILLING:
+            req.prefilled += fed
+            self.counters["prefill_tokens"] += fed
+            if req.prefilled < req.prompt_len:
+                return
+            req.state = DECODING
+        else:
+            self.counters["decode_tokens"] += 1
+        nxt = int(np.argmax(np.asarray(logits)))
+        req.generated.append(nxt)
+        if self.cfg.eos_token_id is not None \
+                and nxt == self.cfg.eos_token_id:
+            self.manager.complete(req, "eos")
+            return
+        if len(req.generated) >= req.max_new_tokens:
+            self.manager.complete(req, "length")
+            return
+        req.next_token = nxt
+
+    def step(self) -> bool:
+        """One serving iteration; returns True if an engine step ran."""
+        t0 = self.clock()
+        if self._drain_requested.is_set() and self.health != DRAINING:
+            self.begin_drain("SIGTERM")
+        inj = get_injector()
+        self.manager.expire()
+        if self.health != DRAINING:
+            self._shed_over_watermarks(forced=bool(inj) and inj.shed_forced())
+            self._admit()
+        batch = self._plan()
+        if not batch:
+            self.counters["idle_steps"] += 1
+            if self.health == DRAINING and not self.manager.active:
+                self.drained = True
+            return False
+        chunk = self.cfg.prefill_chunk
+        uids, chunks = [], []
+        for r in batch:
+            uids.append(r.uid)
+            chunks.append(np.asarray([r.next_token], np.int32)
+                          if r.state == DECODING
+                          else r.prompt[r.prefilled:r.prefilled + chunk])
+        failed = None
+        try:
+            inj.on_serving_step(
+                "decode" if any(r.state == DECODING for r in batch)
+                else "prefill")
+            results = self.engine.put(uids, chunks)
+        except CapacityError as e:
+            # backstop only — _plan() pre-checks joint schedulability; a race
+            # (or an engine-internal reject) sheds one victim and yields
+            victim = max(batch, key=lambda r: (-r.priority, r.submitted_at))
+            self.manager.shed(victim, "capacity")
+            failed = f"capacity: {e}"
+        except (InjectedIOError, OSError) as e:
+            # environmental (cache IO, transport): the step never committed,
+            # every request keeps its position and retries next step
+            failed = f"io: {e}"
+        if failed is None:
+            for r, c in zip(batch, chunks):
+                logits = inj.maybe_poison_logits(results[r.uid]) if inj \
+                    else results[r.uid]
+                if not np.all(np.isfinite(np.asarray(logits, np.float32))):
+                    # the engine committed this token/chunk to KV, so there
+                    # is no clean retry point — resolve the request loudly
+                    self.manager.shed(r, "decode_failure")
+                    failed = f"non-finite logits uid={r.uid}"
+                    continue
+                self._advance(r, len(c), logits)
+        self.steps += 1
+        self.counters["engine_steps"] += 1
+        self._latencies_ms.append((self.clock() - t0) * 1e3)
+        if failed is not None:
+            self.counters["step_failures"] += 1
+            logger.warning(f"serving: step {self.steps} failed ({failed})")
+        self._failures.append(failed is not None)
+        self._update_health()
+        if self.monitor is not None \
+                and self.steps % max(1, self.cfg.monitor_interval) == 0:
+            self.monitor.write_events(self._serving_events())
+        return True
+
+    def pump(self, max_steps: Optional[int] = None) -> int:
+        """Step until no work remains (or drain completes / ``max_steps``).
+        Returns the number of engine steps executed."""
+        ran = 0
+        while max_steps is None or ran < max_steps:
+            if self.drained:
+                break
+            progressed = self.step()
+            if progressed:
+                ran += 1
+                continue
+            if self.health == DRAINING or (
+                    not self.manager.queue and not self.manager.active):
+                break
+        return ran
+
+    # ------------------------------------------------------------------
+    # health + drain
+    # ------------------------------------------------------------------
+    def _update_health(self) -> None:
+        if self.health == DRAINING:
+            return
+        window = self._failures
+        ratio = (sum(window) / len(window)) if window else 0.0
+        if self.health == STARTING and window and not window[-1]:
+            self.health = READY
+        if len(window) == window.maxlen:
+            if self.health == READY \
+                    and ratio >= self.cfg.degrade_failure_ratio:
+                self.health = DEGRADED
+                self.counters["degraded_entries"] += 1
+                logger.warning(
+                    f"serving: DEGRADED (failure ratio {ratio:.2f} over "
+                    f"last {len(window)} steps); capacity reduced to "
+                    f"{self.cfg.degraded_capacity_factor:.0%}")
+            elif self.health == DEGRADED \
+                    and ratio <= self.cfg.degrade_failure_ratio / 2:
+                self.health = READY
+                logger.warning("serving: recovered to READY "
+                               f"(failure ratio {ratio:.2f})")
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM → graceful drain at the next step boundary (preemption
+        parity with the training engine's emergency save)."""
+        def _on_sigterm(signum, frame):
+            logger.warning("serving: SIGTERM — draining")
+            self._drain_requested.set()
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def restore_signal_handlers(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    def begin_drain(self, reason: str = "drain") -> None:
+        """Stop admitting; shed the queue retryably; in-flight work keeps
+        stepping until done (or :meth:`drain`'s timeout abandons it)."""
+        if self.health == DRAINING:
+            return
+        self.health = DRAINING
+        self.drain_reason = reason
+        self.manager.close(reason)
+        for req in list(self.manager.queue):
+            self.manager.shed(req, "draining")
+        logger.warning(f"serving: draining ({reason}); "
+                       f"{len(self.manager.active)} in flight")
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict:
+        """Run the drain to completion: finish in-flight sequences, abandon
+        whatever outlives ``timeout_s`` (KV reclaimed, requests resolved as
+        shed ``drain_timeout``), then mark the batcher drained."""
+        if self.health != DRAINING:
+            self.begin_drain()
+        deadline = self.clock() + (timeout_s if timeout_s is not None
+                                   else self.cfg.drain_timeout_s)
+        while self.manager.active and self.clock() < deadline:
+            self.step()
+        for req in list(self.manager.active.values()):
+            self.manager.shed(req, "drain_timeout")
+        self.drained = True
+        if self.monitor is not None:
+            self.monitor.write_events(self._serving_events())
+        logger.warning(f"serving: drained ({self.drain_reason}); "
+                       f"completed={self.manager.counters['completed']} "
+                       f"shed={self.manager.counters['shed']} "
+                       f"expired={self.manager.counters['expired']}")
+        return self.serving_report()
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def _latency_pct(self, q: float) -> float:
+        if not self._latencies_ms:
+            return 0.0
+        return float(np.percentile(np.asarray(self._latencies_ms), q))
+
+    def serving_report(self) -> Dict:
+        """The serving mirror of the training engine's
+        ``resilience_report()`` — everything a drill or dashboard needs in
+        one dict."""
+        m = self.manager
+        return {
+            "health": self.health,
+            "drained": self.drained,
+            "drain_reason": self.drain_reason,
+            "steps": self.steps,
+            "counters": {**m.counters, **self.counters},
+            "shed_reasons": dict(m.shed_reasons),
+            "queue_depth": m.queue_depth,
+            "active_requests": len(m.active),
+            "kv": {"num_blocks": self.num_blocks,
+                   "used_blocks": self.used_blocks,
+                   "free_blocks": self.num_blocks - self.used_blocks,
+                   "occupancy": round(self.kv_occupancy, 4)},
+            "latency_ms": {"p50": round(self._latency_pct(50), 3),
+                           "p99": round(self._latency_pct(99), 3),
+                           "samples": len(self._latencies_ms)},
+        }
+
+    _HEALTH_CODES = {STARTING: 0, READY: 1, DEGRADED: 2, DRAINING: 3}
+
+    def _serving_events(self):
+        """The ``serving/*`` monitor stream (one gauge per counter), keyed
+        by serving step the way training events key on samples."""
+        s = self.steps
+        m = self.manager
+        events = [("serving/health", float(self._HEALTH_CODES[self.health]),
+                   s),
+                  ("serving/queue_depth", float(m.queue_depth), s),
+                  ("serving/active_requests", float(len(m.active)), s),
+                  ("serving/kv_occupancy", float(self.kv_occupancy), s),
+                  ("serving/step_p50_ms", self._latency_pct(50), s),
+                  ("serving/step_p99_ms", self._latency_pct(99), s)]
+        for k in ("submitted", "rejected", "admitted", "completed", "shed",
+                  "expired", "cancelled"):
+            events.append((f"serving/{k}", float(m.counters[k]), s))
+        for k in ("engine_steps", "step_failures", "decode_tokens",
+                  "prefill_tokens", "degraded_entries"):
+            events.append((f"serving/{k}", float(self.counters[k]), s))
+        return events

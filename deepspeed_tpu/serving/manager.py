@@ -1,0 +1,208 @@
+"""Request admission, deadlines, and shedding bookkeeping.
+
+:class:`RequestManager` owns everything about a request EXCEPT the device
+step: the bounded admission queue, per-request deadlines (absolute, checked
+against an injectable clock so tests are deterministic), cancellation, and
+the terminal ledger. KV/slot reclamation is delegated to ``release_fn`` —
+the :class:`~deepspeed_tpu.serving.batcher.ContinuousBatcher` points it at
+``InferenceEngineV2.flush``, so expiring or shedding an in-flight request
+releases its blocks through the same path a completed request does (no
+second accounting scheme to leak through).
+
+The admitted-uid resolution invariant lives here: every uid that ever left
+the queue lands in exactly one of ``completed | shed | expired | cancelled``,
+and :meth:`resolve` answers for any uid ever submitted.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
+                                           EXPIRED, PREFILLING, QUEUED, SHED,
+                                           ServeRequest, ShedError, as_prompt)
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["RequestManager"]
+
+
+class RequestManager:
+    def __init__(self, max_queue_depth: int = 64,
+                 default_max_new_tokens: int = 128,
+                 default_deadline_s: Optional[float] = None,
+                 retry_after_s: float = 1.0,
+                 release_fn: Optional[Callable[[Sequence[int]], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.default_deadline_s = default_deadline_s
+        self.retry_after_s = float(retry_after_s)
+        self.release_fn = release_fn
+        self.clock = clock
+        self.queue: Deque[ServeRequest] = deque()
+        self.active: Dict[int, ServeRequest] = {}   # admitted, on the engine
+        self.done: Dict[int, ServeRequest] = {}     # terminal ledger
+        self._next_uid = 0
+        self._closed_reason: Optional[str] = None
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "rejected": 0, "admitted": 0, "completed": 0,
+            "shed": 0, "expired": 0, "cancelled": 0,
+        }
+        self.shed_reasons: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> int:
+        """Enqueue a request; returns its uid. Raises :class:`ShedError`
+        (``reason=queue_full`` or ``draining``, both retryable) instead of
+        growing the queue without bound — admission control IS the refusal."""
+        self.counters["submitted"] += 1
+        if self._closed_reason is not None:
+            self.counters["rejected"] += 1
+            raise ShedError("draining", retryable=True,
+                            retry_after_s=self.retry_after_s,
+                            detail=self._closed_reason)
+        if len(self.queue) >= self.max_queue_depth:
+            self.counters["rejected"] += 1
+            raise ShedError("queue_full", retryable=True,
+                            retry_after_s=self.retry_after_s,
+                            detail=f"depth {len(self.queue)} >= "
+                                   f"{self.max_queue_depth}")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        now = self.clock()
+        req = ServeRequest(
+            uid=self._next_uid, prompt=as_prompt(prompt),
+            max_new_tokens=int(max_new_tokens
+                               if max_new_tokens is not None
+                               else self.default_max_new_tokens),
+            priority=int(priority),
+            deadline=None if deadline_s is None else now + float(deadline_s),
+            submitted_at=now)
+        self._next_uid += 1
+        self.queue.append(req)
+        return req.uid
+
+    def close(self, reason: str = "draining") -> None:
+        """Stop admitting new requests (graceful-drain entry)."""
+        self._closed_reason = reason
+
+    @property
+    def closed(self) -> bool:
+        return self._closed_reason is not None
+
+    # ------------------------------------------------------------------
+    # lifecycle transitions (called by the batcher)
+    # ------------------------------------------------------------------
+    def admit(self, req: ServeRequest) -> None:
+        self.queue.remove(req)
+        req.state = PREFILLING
+        self.active[req.uid] = req
+        self.counters["admitted"] += 1
+
+    def _finish(self, req: ServeRequest, state: str) -> None:
+        if req.uid in self.active:
+            del self.active[req.uid]
+            if self.release_fn is not None:
+                # in-flight: give back KV blocks + slot through the engine's
+                # own flush path, whatever the terminal state
+                self.release_fn([req.uid])
+        elif req in self.queue:
+            self.queue.remove(req)
+        req.state = state
+        req.finished_at = self.clock()
+        self.done[req.uid] = req
+
+    def complete(self, req: ServeRequest, finish_reason: str = "length"
+                 ) -> None:
+        req.finish_reason = finish_reason
+        self._finish(req, COMPLETED)
+        self.counters["completed"] += 1
+
+    def shed(self, req: ServeRequest, reason: str, retryable: bool = True
+             ) -> None:
+        req.error = ShedError(reason, uid=req.uid, retryable=retryable,
+                              retry_after_s=self.retry_after_s)
+        req.finish_reason = reason
+        self._finish(req, SHED)
+        self.counters["shed"] += 1
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        logger.warning(f"serving: shed uid={req.uid} ({reason}, "
+                       f"prefilled={req.prefilled}/{req.prompt_len}, "
+                       f"generated={len(req.generated)})")
+
+    def cancel(self, uid: int, reason: str = "cancelled") -> bool:
+        """User-initiated cancellation; True if the request was still live."""
+        req = self.active.get(uid)
+        if req is None:
+            req = next((r for r in self.queue if r.uid == uid), None)
+        if req is None:
+            return False
+        req.finish_reason = reason
+        self._finish(req, CANCELLED)
+        self.counters["cancelled"] += 1
+        return True
+
+    def expire(self, now: Optional[float] = None) -> List[ServeRequest]:
+        """Expire every queued or in-flight request past its deadline.
+        In-flight expiry reclaims KV/slot via ``release_fn`` — a prompt
+        half-prefilled when its deadline lands must not leak a single
+        block."""
+        if now is None:
+            now = self.clock()
+        victims = [r for r in list(self.queue) if r.expired(now)]
+        victims += [r for r in list(self.active.values()) if r.expired(now)]
+        for req in victims:
+            req.finish_reason = "deadline"
+            self._finish(req, EXPIRED)
+            self.counters["expired"] += 1
+            logger.warning(f"serving: deadline expired uid={req.uid} "
+                           f"(prefilled={req.prefilled}/{req.prompt_len}, "
+                           f"generated={len(req.generated)})")
+        return victims
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    def resolve(self, uid: int) -> Optional[str]:
+        """Terminal/current state for any uid ever submitted, or None for an
+        unknown uid. Drills assert every admitted uid resolves terminal."""
+        if uid in self.done:
+            return self.done[uid].state
+        if uid in self.active:
+            return self.active[uid].state
+        if any(r.uid == uid for r in self.queue):
+            return QUEUED
+        return None
+
+    def result(self, uid: int) -> Optional[ServeRequest]:
+        return self.done.get(uid) or self.active.get(uid) or next(
+            (r for r in self.queue if r.uid == uid), None)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def queued_by_shed_order(self) -> List[ServeRequest]:
+        return sorted(self.queue, key=ServeRequest.shed_key)
+
+    def active_by_shed_order(self) -> List[ServeRequest]:
+        return sorted(self.active.values(), key=ServeRequest.shed_key)
+
+    def decoding(self) -> List[ServeRequest]:
+        return [r for r in self.active.values() if r.state == DECODING]
+
+    def prefilling(self) -> List[ServeRequest]:
+        return [r for r in self.active.values() if r.state == PREFILLING]
+
+    def report(self) -> Dict:
+        return {"queue_depth": self.queue_depth,
+                "active": len(self.active),
+                "closed": self.closed,
+                "counters": dict(self.counters),
+                "shed_reasons": dict(self.shed_reasons)}

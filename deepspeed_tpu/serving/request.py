@@ -1,0 +1,118 @@
+"""Request lifecycle primitives for the serving layer.
+
+A request moves through a small, explicit state machine; every terminal
+state is recorded so the serving invariant — *no admitted request is ever
+silently lost* — is checkable from the outside (``tools/serve_drill.py``
+asserts it after every drill):
+
+    QUEUED ──admit──▶ PREFILLING ──▶ DECODING ──▶ COMPLETED
+       │                  │              │
+       └──────── shed / expire / cancel ─┴──▶ SHED | EXPIRED | CANCELLED
+
+``ShedError`` is the typed backpressure signal: it says *the system chose to
+drop this request because of load*, distinguishes retryable overload (queue
+full, KV pressure, draining) from terminal causes, and carries a
+``retry_after_s`` hint so clients can back off instead of hammering an
+overloaded server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["QUEUED", "PREFILLING", "DECODING", "COMPLETED", "SHED",
+           "EXPIRED", "CANCELLED", "TERMINAL_STATES", "ShedError",
+           "ServeRequest"]
+
+QUEUED = "queued"
+PREFILLING = "prefilling"
+DECODING = "decoding"
+COMPLETED = "completed"
+SHED = "shed"
+EXPIRED = "expired"
+CANCELLED = "cancelled"
+
+TERMINAL_STATES = (COMPLETED, SHED, EXPIRED, CANCELLED)
+
+
+class ShedError(RuntimeError):
+    """The serving layer dropped (or refused) a request because of load.
+
+    ``reason`` is a stable machine-readable slug (``queue_full``,
+    ``kv_pressure``, ``queue_pressure``, ``shed_storm``, ``draining``,
+    ``drain_timeout``, ``decode_failure``, ``capacity``, ``oversize``);
+    ``retryable`` tells the client whether resubmitting later can succeed
+    (overload sheds — including ``capacity`` — are retryable; ``oversize``,
+    a request that can never fit, is not)."""
+
+    def __init__(self, reason: str, uid: Optional[int] = None,
+                 retryable: bool = True,
+                 retry_after_s: Optional[float] = None, detail: str = ""):
+        self.reason = reason
+        self.uid = uid
+        self.retryable = bool(retryable)
+        self.retry_after_s = retry_after_s
+        msg = f"request shed ({reason})"
+        if uid is not None:
+            msg += f" uid={uid}"
+        if retryable:
+            msg += (f"; retry after {retry_after_s:.1f}s"
+                    if retry_after_s else "; retryable")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One in-flight generation request and its full lifecycle record."""
+
+    uid: int
+    prompt: np.ndarray                 # int32 [prompt_len]
+    max_new_tokens: int
+    priority: int = 0                  # higher = shed later
+    deadline: Optional[float] = None   # absolute clock() time, None = none
+    submitted_at: float = 0.0
+    state: str = QUEUED
+    # progress
+    prefilled: int = 0                 # prompt tokens already in KV
+    generated: List[int] = dataclasses.field(default_factory=list)
+    next_token: Optional[int] = None   # token to feed on the next decode step
+    # terminal bookkeeping
+    finish_reason: str = ""            # length | eos | shed slug | expired
+    error: Optional[ShedError] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(len(self.prompt))
+
+    @property
+    def total_token_demand(self) -> int:
+        """Worst-case KV footprint in tokens (admission uses this so a
+        request admitted under pressure cannot strand mid-generation)."""
+        return self.prompt_len + self.max_new_tokens
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def shed_key(self) -> tuple:
+        """Sort key for victim selection: lowest priority first, then newest
+        (LIFO within a priority class — the request that waited longest keeps
+        its place)."""
+        return (self.priority, -self.submitted_at)
+
+
+def as_prompt(tokens: Sequence[int]) -> np.ndarray:
+    arr = np.atleast_1d(np.asarray(tokens, np.int32))
+    if arr.ndim != 1 or arr.size == 0:
+        raise ValueError(f"prompt must be a non-empty 1-D token sequence, "
+                         f"got shape {arr.shape}")
+    return arr

@@ -1,0 +1,142 @@
+"""PR 2 coordination drills on a REAL 2-process ``jax.distributed`` fixture.
+
+The thread-simulated ``ThreadFleet`` reducers in ``test_resilience.py``
+exercise the decision algebra; these tests exercise the actual
+cross-process plane: two separate Python processes rendezvous through
+``jax.distributed.initialize`` and agree via
+:func:`~deepspeed_tpu.resilience.kv_store_max_reduce` — the coordination
+service's key-value store, the reduce path that works even where
+multi-process device collectives do not (the CPU backend these tests run
+on). Split-brain preemption must converge to a fleet SAVE with IDENTICAL
+committed tags, and a single peer's abort vote must abort everyone.
+
+Marked ``slow``: each test pays two interpreter + rendezvous startups.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    rank, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    import jax
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=rank)
+    import numpy as np
+    from deepspeed_tpu.resilience import (ABORT, CONTINUE, SAVE,
+                                          ResilienceCoordinator,
+                                          kv_store_max_reduce)
+    from deepspeed_tpu.resilience.manager import write_manifest
+    from deepspeed_tpu.runtime.checkpoint import (read_latest_tag,
+                                                  write_latest_atomic)
+
+    step = 5
+    coord = ResilienceCoordinator(
+        reduce_fn=kv_store_max_reduce(num_processes=2, rank=rank,
+                                      timeout_ms=60_000))
+    out = {"rank": rank}
+
+    # drill 1: split-brain preemption -> fleet SAVE, identical tags
+    preempted = rank == 0                # only host 0 got the SIGTERM
+    local = SAVE if preempted else CONTINUE
+    decision = coord.decide(step, local,
+                            "preemption notice" if preempted else "")
+    out["save_decision"] = decision
+    out["save_reason"] = coord.last_reason
+    # commit with the manager's protocol — data -> manifest (stamped with
+    # the fleet decision) -> atomic latest. The orbax tensor save needs
+    # multi-process device collectives the CPU backend lacks; what this
+    # drill pins is the cross-process agreement + commit ordering + stamp.
+    tag = f"preempt_step{step}"
+    host_dir = os.path.join(workdir, f"host{rank}")
+    tag_dir = os.path.join(host_dir, tag)
+    os.makedirs(tag_dir, exist_ok=True)
+    np.savez(os.path.join(tag_dir, "state.npz"),
+             w=np.arange(4.0), step=np.int32(step))
+    write_manifest(tag_dir, step,
+                   extra={"coordination": coord.decision_record()})
+    write_latest_atomic(host_dir, tag)
+    out["tag"] = tag
+    out["latest"] = read_latest_tag(host_dir)
+
+    # drill 2: one peer's abort vote aborts everyone at the same boundary
+    if rank == 1:
+        coord.signal_abort("hang: stuck collective all_reduce_host")
+    out["abort_decision"] = coord.decide(7)
+    out["abort_reason"] = coord.last_reason
+    out["counters"] = coord.counters
+
+    with open(os.path.join(workdir, f"result_{rank}.json"), "w") as f:
+        json.dump(out, f)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_fleet(tmp_path) -> list:
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH":
+           _REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(rank), str(port), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process fleet wedged (rendezvous or reduce hang)")
+        logs.append(out.decode(errors="replace"))
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker died rc={p.returncode}:\n{log}"
+    return [json.loads((tmp_path / f"result_{r}.json").read_text())
+            for r in range(2)]
+
+
+def test_two_process_coordination_drills(tmp_path):
+    from deepspeed_tpu.resilience import ABORT, SAVE
+    from deepspeed_tpu.resilience.manager import verify_tag_dir
+
+    r0, r1 = _run_fleet(tmp_path)
+    # split-brain preemption converged to a fleet SAVE on both processes
+    assert r0["save_decision"] == r1["save_decision"] == SAVE
+    assert r0["save_reason"] == "preemption notice"     # the signaled host
+    assert r1["save_reason"] == "peer signal"           # its peer
+    # ...with the IDENTICAL tag committed and verified on each host
+    assert r0["tag"] == r1["tag"] == "preempt_step5"
+    for rank, res in ((0, r0), (1, r1)):
+        assert res["latest"] == res["tag"]
+        host = tmp_path / f"host{rank}"
+        ok, why = verify_tag_dir(str(host / res["tag"]))
+        assert ok, why
+        manifest = json.load(open(host / res["tag"] / "manifest.json"))
+        assert manifest["coordination"]["decision"] == "SAVE"
+        assert manifest["coordination"]["step"] == 5
+    # a single peer's abort vote aborted BOTH at the same boundary
+    assert r0["abort_decision"] == r1["abort_decision"] == ABORT
+    assert r1["abort_reason"].startswith("hang")
+    assert r0["abort_reason"].startswith("peer signal")
+    # every agreement really crossed the process boundary (2 collectives)
+    assert r0["counters"]["collectives"] == 2
+    assert r1["counters"]["collectives"] == 2

@@ -7,6 +7,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -55,6 +56,121 @@ def test_agent_gives_up_below_min():
     res = agent.run(lambda c, m, i: 1, chips=8)
     assert not res.succeeded
     assert res.history[-1].chips == 8  # nothing admissible below → stop
+
+
+class TestCohortSupervisor:
+    """Agent-side heartbeat supervision: a cohort wedged so hard its
+    in-process watchdog cannot run must be killed from OUTSIDE off its
+    stale heartbeat files."""
+
+    # child: writes one heartbeat, then wedges (no further writes — the
+    # simulated state where every in-process thread is stuck)
+    STALLED = textwrap.dedent("""
+        import json, os, sys, time
+        hb = sys.argv[1]
+        os.makedirs(hb, exist_ok=True)
+        with open(os.path.join(hb, "heartbeat_0.json"), "w") as f:
+            json.dump({"rank": 0, "pid": os.getpid(), "step": 1}, f)
+        time.sleep(120)
+    """)
+
+    def test_stalled_child_killed_and_respawn_path_taken(self, tmp_path):
+        from deepspeed_tpu.elasticity import CohortSupervisor
+
+        hb = tmp_path / "heartbeats"
+        script = tmp_path / "stalled.py"
+        script.write_text(self.STALLED)
+        sup = CohortSupervisor(str(hb), deadline_s=0.6, poll_s=0.1,
+                               grace_s=2.0)
+        proc = subprocess.Popen([sys.executable, str(script), str(hb)])
+        rc = sup.watch(proc)
+        assert rc != 0                       # killed, not clean exit
+        assert sup.kills == 1
+        assert "stale cohort heartbeats" in sup.last_cause
+        # the agent treats the nonzero exit as an ordinary host loss
+        agent = ElasticAgent(ECFG, max_restarts=1)
+        calls = []
+
+        def spawn(chips, micro, idx):
+            if not calls:
+                calls.append("wedged")
+                p = subprocess.Popen([sys.executable, str(script), str(hb)])
+                return sup.watch(p)
+            calls.append("healthy")
+            return 0
+
+        res = agent.run(spawn, chips=8)
+        assert res.succeeded and res.restarts == 1
+        assert sup.kills == 2
+
+    def test_respawn_not_killed_off_previous_cohorts_stale_beats(
+            self, tmp_path):
+        """After a hang-kill the dead cohort's heartbeat files are (by
+        construction) already past the deadline; a respawned cohort must
+        not be killed off them before it writes its own first beat."""
+        from deepspeed_tpu.elasticity import CohortSupervisor
+
+        hb = tmp_path / "heartbeats"
+        hb.mkdir()
+        stale = hb / "heartbeat_0.json"
+        stale.write_text("{}")
+        past = time.time() - 3600.0
+        os.utime(stale, (past, past))           # the previous incarnation
+        script = tmp_path / "late.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, sys, time
+            time.sleep(1.0)                     # "startup compile"
+            with open(os.path.join(sys.argv[1],
+                                   "heartbeat_0.json"), "w") as f:
+                json.dump({"rank": 0}, f)
+        """))
+        sup = CohortSupervisor(str(hb), deadline_s=0.4, poll_s=0.1)
+        proc = subprocess.Popen([sys.executable, str(script), str(hb)])
+        assert sup.watch(proc) == 0             # survived its slow startup
+        assert sup.kills == 0
+
+    def test_healthy_child_not_killed(self, tmp_path):
+        """A cohort that keeps beating (or exits cleanly) is left alone."""
+        from deepspeed_tpu.elasticity import CohortSupervisor
+
+        hb = tmp_path / "heartbeats"
+        script = tmp_path / "healthy.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, sys, time
+            hb = sys.argv[1]
+            os.makedirs(hb, exist_ok=True)
+            for _ in range(6):
+                with open(os.path.join(hb, "heartbeat_0.json"), "w") as f:
+                    json.dump({"rank": 0, "pid": os.getpid()}, f)
+                time.sleep(0.1)
+        """))
+        sup = CohortSupervisor(str(hb), deadline_s=0.5, poll_s=0.1)
+        proc = subprocess.Popen([sys.executable, str(script), str(hb)])
+        assert sup.watch(proc) == 0
+        assert sup.kills == 0
+
+    def test_supervised_spawn_wires_env_and_heartbeat_dir(self, tmp_path):
+        from deepspeed_tpu.elasticity import supervised_subprocess_spawn
+
+        script = tmp_path / "trainer.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, sys
+            out = {k: os.environ[k] for k in
+                   ("DSTPU_ELASTIC_CHIPS", "DSTPU_ELASTIC_MICRO",
+                    "DSTPU_RESTART_COUNT", "DSTPU_CHECKPOINT_DIR")}
+            with open(sys.argv[1], "w") as f:
+                json.dump(out, f)
+        """))
+        sink = tmp_path / "env.json"
+        spawn, sup = supervised_subprocess_spawn(
+            str(script), [str(sink)], dict(os.environ), str(tmp_path),
+            deadline_s=30.0)
+        assert spawn(4, 2, 1) == 0
+        env = json.loads(sink.read_text())
+        assert env["DSTPU_ELASTIC_CHIPS"] == "4"
+        assert env["DSTPU_RESTART_COUNT"] == "1"
+        assert sup.hb_dir == os.path.join(str(tmp_path), "heartbeats")
+        assert sup.kills == 0
 
 
 def test_elastic_engine_batch_resolution(eight_devices):

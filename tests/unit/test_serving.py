@@ -1,0 +1,235 @@
+"""Serving-resilience unit tests (``deepspeed_tpu/serving``).
+
+Fast tests pin the request-lifecycle contracts directly: the
+:class:`RequestManager` ledger (every uid resolves; typed retryable
+``ShedError`` refusals), the satellite invariant that a deadline landing
+MID-chunked-prefill releases every KV block through the engine's own flush
+path (asserted via ``SequenceManager`` + allocator accounting), the typed
+:class:`CapacityError` overload surface on ``InferenceEngineV2.put``, and
+the ``serving/*`` monitor stream + ``serving_report()`` acceptance shape.
+
+The end-to-end overload/failure scenarios live in ``tools/serve_drill.py``;
+the ``slow``-marked wrappers at the bottom run them under pytest the way
+``test_chaos_drill.py`` wraps the training drills.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import MonitorConfig, ServingConfig
+from deepspeed_tpu.serving import (COMPLETED, EXPIRED, QUEUED, SHED,
+                                   ContinuousBatcher, RequestManager,
+                                   ShedError)
+
+pytestmark = pytest.mark.serving
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+
+
+# ---------------------------------------------------------------------------
+# RequestManager: ledger + typed refusals (no engine needed)
+# ---------------------------------------------------------------------------
+
+class TestRequestManager:
+    def test_queue_full_raises_typed_retryable_shed(self):
+        mgr = RequestManager(max_queue_depth=2, retry_after_s=2.5)
+        for _ in range(2):
+            mgr.submit([1, 2, 3])
+        with pytest.raises(ShedError) as ei:
+            mgr.submit([1, 2, 3])
+        e = ei.value
+        assert isinstance(e, RuntimeError)      # legacy catch-surface holds
+        assert e.reason == "queue_full" and e.retryable
+        assert e.retry_after_s == 2.5
+        assert mgr.counters["rejected"] == 1
+
+    def test_closed_manager_refuses_with_draining(self):
+        mgr = RequestManager()
+        mgr.close("preemption")
+        with pytest.raises(ShedError) as ei:
+            mgr.submit([1])
+        assert ei.value.reason == "draining" and ei.value.retryable
+
+    def test_every_uid_resolves_and_inflight_release_goes_through_flush(self):
+        released = []
+        now = [0.0]
+        mgr = RequestManager(release_fn=released.append,
+                             clock=lambda: now[0])
+        u_queued = mgr.submit([1, 2], deadline_s=5.0)
+        u_active = mgr.submit([3, 4])
+        u_done = mgr.submit([5, 6])
+        for uid in (u_active, u_done):
+            mgr.admit(mgr.result(uid))
+        mgr.complete(mgr.result(u_done))
+        mgr.shed(mgr.result(u_active), "kv_pressure")
+        now[0] = 10.0                       # the queued request's deadline
+        expired = mgr.expire()
+        assert [r.uid for r in expired] == [u_queued]
+        assert mgr.resolve(u_queued) == EXPIRED
+        assert mgr.resolve(u_active) == SHED
+        assert mgr.resolve(u_done) == COMPLETED
+        assert mgr.resolve(999) is None
+        # only ADMITTED work holds engine resources: the completed and the
+        # shed request released through flush, the queued one never held any
+        assert released == [[u_done], [u_active]]
+        assert mgr.counters == {"submitted": 3, "rejected": 0, "admitted": 2,
+                                "completed": 1, "shed": 1, "expired": 1,
+                                "cancelled": 0}
+
+    def test_shed_order_is_lowest_priority_then_newest(self):
+        now = [0.0]
+        mgr = RequestManager(clock=lambda: now[0])
+        lo_old = mgr.submit([1], priority=0)
+        now[0] = 1.0
+        hi = mgr.submit([1], priority=5)
+        now[0] = 2.0
+        lo_new = mgr.submit([1], priority=0)
+        order = [r.uid for r in mgr.queued_by_shed_order()]
+        assert order == [lo_new, lo_old, hi]
+        assert mgr.resolve(hi) == QUEUED
+
+
+# ---------------------------------------------------------------------------
+# engine-backed contracts
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    return InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                             max_sequences=8, max_seq_len=128, block_size=16)
+
+
+def test_put_overload_raises_typed_capacity_error(tiny_engine):
+    from deepspeed_tpu.inference import CapacityError
+
+    demand = tiny_engine.max_seq_len + 8    # can never fit one sequence
+    with pytest.raises(CapacityError) as ei:
+        tiny_engine.put([999], [np.zeros(demand, np.int32)])
+    e = ei.value
+    assert isinstance(e, RuntimeError)      # compatibility base class
+    assert e.uids == [999] and e.token_demand == [demand]
+    assert 999 not in tiny_engine.state.sequences   # refused, not leaked
+
+
+def test_deadline_expiry_mid_chunked_prefill_releases_all_kv(tiny_engine):
+    """Satellite invariant: a request whose deadline lands while its prompt
+    is only PARTIALLY prefilled must give back every KV block and its slot
+    — asserted via the SequenceManager/allocator accounting itself."""
+    alloc = tiny_engine.state.allocator
+    free0 = alloc.free_blocks
+    live0 = set(tiny_engine.state.sequences)
+    now = [0.0]
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=4)
+    b = ContinuousBatcher(tiny_engine, cfg, clock=lambda: now[0])
+    uid = b.submit(np.arange(96) % 250, deadline_s=5.0)   # 3 chunks of 32
+    assert b.step()                          # admit + first prefill chunk
+    req = b.manager.active[uid]
+    assert 0 < req.prefilled < req.prompt_len
+    assert alloc.free_blocks < free0         # chunk really holds blocks
+    now[0] = 10.0                            # deadline passes mid-prefill
+    b.step()
+    assert b.manager.resolve(uid) == EXPIRED
+    done = b.manager.done[uid]
+    assert 0 < done.prefilled < done.prompt_len   # expired MID-prefill
+    assert alloc.free_blocks == free0             # no pool leak
+    assert set(tiny_engine.state.sequences) == live0  # slot given back
+
+
+def test_from_deepspeed_config_consumes_serving_section(tiny_engine):
+    from deepspeed_tpu.config import DeepSpeedTpuConfig
+
+    cfg = DeepSpeedTpuConfig(train_batch_size=8, serving={
+        "enabled": True, "max_queue_depth": 7, "prefill_chunk": 16})
+    b = ContinuousBatcher.from_deepspeed_config(tiny_engine, cfg)
+    assert b.cfg.max_queue_depth == 7 and b.manager.max_queue_depth == 7
+    disabled = DeepSpeedTpuConfig(train_batch_size=8)
+    with pytest.raises(ValueError, match="serving.enabled"):
+        ContinuousBatcher.from_deepspeed_config(tiny_engine, disabled)
+
+
+def test_unadmittable_head_is_shed_terminal_not_livelocked(tiny_engine):
+    """A head-of-line request that fits ``max_seq_len`` but can NEVER fit
+    the KV budget must be shed terminally (``oversize``) — and ``pump()``
+    must terminate instead of spinning on an unadmittable head."""
+    cfg = ServingConfig(prefill_chunk=32, kv_high_watermark=0.05,
+                        kv_low_watermark=0.04)   # budget: 3 of 64 blocks
+    b = ContinuousBatcher(tiny_engine, cfg)
+    uid = b.submit(np.arange(60) % 250, max_new_tokens=8)  # needs 5 blocks
+    b.pump(max_steps=10)                         # must return, not spin
+    assert b.manager.resolve(uid) == SHED
+    done = b.manager.done[uid]
+    assert done.error.reason == "oversize" and not done.error.retryable
+
+
+def test_admission_budgets_projected_demand_not_live_occupancy(tiny_engine):
+    """Admitting N requests in one sweep must charge each one's worst-case
+    KV demand against the budget — live occupancy alone would admit them
+    all and strand them mid-generation under kv_pressure sheds."""
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=4,
+                        kv_high_watermark=0.10,  # budget: 6.4 of 64 blocks
+                        kv_low_watermark=0.05)
+    b = ContinuousBatcher(tiny_engine, cfg)
+    uids = [b.submit(np.arange(60) % 250) for _ in range(2)]  # 4 blocks each
+    b.step()
+    assert len(b.manager.active) == 1            # joint worst case > budget
+    assert b.manager.resolve(uids[1]) == QUEUED  # waiting, not shed
+    b.pump(max_steps=60)
+    assert all(b.manager.resolve(u) == COMPLETED for u in uids)
+    assert b.manager.counters["shed"] == 0       # nobody was stranded
+
+
+def test_serving_report_and_monitor_stream(tiny_engine, tmp_path):
+    """Acceptance shape: ``serving_report()`` carries the lifecycle counters
+    + queue/KV occupancy, and the SAME counters stream through a real
+    monitor backend (CSV) under the ``serving/*`` prefix."""
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    mon = MonitorMaster(MonitorConfig(csv_monitor={
+        "enabled": True, "output_path": str(tmp_path), "job_name": "serve"}))
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=4,
+                        monitor_interval=1)
+    b = ContinuousBatcher(tiny_engine, cfg, monitor=mon)
+    uids = [b.submit(np.arange(20) % 250) for _ in range(3)]
+    b.pump(max_steps=50)
+    rep = b.serving_report()
+    assert all(b.manager.resolve(u) == COMPLETED for u in uids)
+    for key in ("admitted", "shed", "expired", "completed"):
+        assert key in rep["counters"]
+    assert rep["counters"]["admitted"] == rep["counters"]["completed"] == 3
+    assert rep["queue_depth"] == 0
+    assert 0.0 <= rep["kv"]["occupancy"] <= 1.0
+    assert rep["latency_ms"]["p99"] >= rep["latency_ms"]["p50"] >= 0.0
+    # the same counters, as serving/* events, through the CSV backend
+    outdir = tmp_path / "serve"
+    for tag in ("serving_admitted", "serving_shed", "serving_expired",
+                "serving_completed", "serving_queue_depth",
+                "serving_kv_occupancy", "serving_health",
+                "serving_step_p99_ms"):
+        assert (outdir / f"{tag}.csv").exists(), tag
+    last = (outdir / "serving_completed.csv").read_text().strip(
+        ).splitlines()[-1]
+    assert float(last.split(",")[1]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# drill wrappers (slow; the CLI is the invariant authority)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["deadline-storm",
+                                      "shed-under-kv-pressure",
+                                      "sigterm-drain"])
+def test_serve_drill_scenario(scenario, tmp_path):
+    import sys
+
+    sys.path.insert(0, _TOOLS)
+    from serve_drill import run_scenario
+
+    verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict

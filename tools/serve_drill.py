@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Serving chaos drill CLI: drive the request-lifecycle layer
+(``deepspeed_tpu/serving``) through a named overload/failure scenario and
+exit nonzero if the serving invariants fail — the serving face of
+``tools/chaos_drill.py``.
+
+Invariants asserted after EVERY drill:
+
+* **no KV-block leak** — the engine's block pool accounting returns to its
+  initial state (every allocated block freed, no live sequences);
+* **no request silently lost** — every admitted uid resolves to
+  ``completed | shed | expired`` in the terminal ledger;
+* scenario-specific checks (deadlines actually expired, sheds actually
+  typed/retryable, drain actually closed admission and finished in-flight).
+
+    python tools/serve_drill.py --list
+    python tools/serve_drill.py --scenario deadline-storm
+    python tools/serve_drill.py --scenario shed-under-kv-pressure
+    python tools/serve_drill.py --scenario sigterm-drain
+
+Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
+Slow pytest wrappers live in ``tests/unit/test_serving.py`` under the
+``serving`` + ``slow`` markers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_batcher(num_blocks=None, monitor=None, clock=time.monotonic,
+                  **serving):
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    eng = InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                            max_sequences=8, max_seq_len=128, block_size=16,
+                            num_blocks=num_blocks)
+    cfg = ServingConfig(**{"prefill_chunk": 32, "default_max_new_tokens": 8,
+                           **serving})
+    return ContinuousBatcher(eng, cfg, monitor=monitor, clock=clock)
+
+
+def _fresh_injector():
+    from deepspeed_tpu.resilience import set_injector
+
+    set_injector(None)
+
+
+def _invariants(b, uids) -> dict:
+    """The cross-scenario serving invariants (see module doc)."""
+    alloc = b.engine.state.allocator
+    unresolved = {u: b.manager.resolve(u) for u in uids
+                  if b.manager.resolve(u)
+                  not in ("completed", "shed", "expired")}
+    return {
+        "kv_pool_restored": alloc.free_blocks == alloc.num_blocks,
+        "free_blocks": alloc.free_blocks, "num_blocks": alloc.num_blocks,
+        "live_sequences": len(b.engine.state.sequences),
+        "unresolved_uids": unresolved,
+        "ok": (alloc.free_blocks == alloc.num_blocks
+               and not b.engine.state.sequences and not unresolved),
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenarios: each returns (ok: bool, details: dict)
+# ---------------------------------------------------------------------------
+
+def scenario_deadline_storm(workdir):
+    """A burst of requests with deadlines too tight for the queue they join,
+    plus one injected cache_io_error step. Invariant: every expired request
+    — including ones caught mid-chunked-prefill — releases all KV blocks;
+    the IO-failed step loses no request; survivors with generous deadlines
+    still complete."""
+    import numpy as np
+
+    from deepspeed_tpu.resilience import FaultInjector, set_injector
+
+    now = [0.0]
+    b = _make_batcher(clock=lambda: now[0], default_max_new_tokens=4,
+                      max_queue_depth=32)
+    # one engine step fails on KV-cache IO; the batcher must retry, not drop
+    set_injector(FaultInjector([{"kind": "cache_io_error", "times": 1}]))
+    real_step = b.step
+
+    def step():
+        ran = real_step()
+        if ran:
+            now[0] += 1.0
+        return ran
+    b.step = step
+    rng = np.random.default_rng(0)
+    tight = [b.submit(rng.integers(0, 250, 96), deadline_s=2.5)
+             for _ in range(6)]            # 96-token prompts need 3 chunks
+    loose = [b.submit(rng.integers(0, 250, 40), deadline_s=60.0)
+             for _ in range(4)]
+    b.pump(max_steps=200)
+    rep = b.serving_report()
+    inv = _invariants(b, tight + loose)
+    details = {"report": rep, "invariants": inv,
+               "tight": {u: b.manager.resolve(u) for u in tight},
+               "loose": {u: b.manager.resolve(u) for u in loose}}
+    ok = (inv["ok"] and rep["counters"]["expired"] >= 1
+          and all(b.manager.resolve(u) == "completed" for u in loose)
+          and rep["counters"]["completed"] >= len(loose)
+          and rep["counters"]["step_failures"] == 1)
+    return ok, details
+
+
+def scenario_shed_under_kv_pressure(workdir):
+    """More aggregate KV demand than the pool holds, then a shed_storm
+    fault on top. Invariant: the batcher sheds lowest-priority/newest with
+    typed retryable ShedErrors instead of CapacityError escaping put();
+    the high-priority request completes; the pool drains back to empty."""
+    import numpy as np
+
+    from deepspeed_tpu.resilience import FaultInjector, set_injector
+    from deepspeed_tpu.serving import ShedError
+
+    b = _make_batcher(num_blocks=12, default_max_new_tokens=16,
+                      kv_high_watermark=0.8, kv_low_watermark=0.5,
+                      max_queue_depth=8)
+    rng = np.random.default_rng(1)
+    vip = b.submit(rng.integers(0, 250, 60), priority=10)
+    crowd = [b.submit(rng.integers(0, 250, 60)) for _ in range(6)]
+    rejected = 0
+    try:
+        for _ in range(4):           # overflow the bounded queue
+            b.submit(rng.integers(0, 250, 60))
+    except ShedError as e:
+        rejected += 1
+        retryable = e.retryable and e.reason == "queue_full"
+    else:
+        retryable = False
+    b.pump(max_steps=30)
+    set_injector(FaultInjector([{"kind": "shed_storm", "times": 2}]))
+    b.pump(max_steps=300)
+    _fresh_injector()
+    b.pump(max_steps=300)
+    rep = b.serving_report()
+    inv = _invariants(b, [vip] + crowd)
+    shed_reqs = [b.manager.done[u] for u in crowd
+                 if b.manager.resolve(u) == "shed"]
+    details = {"report": rep, "invariants": inv,
+               "vip": b.manager.resolve(vip),
+               "crowd": {u: b.manager.resolve(u) for u in crowd},
+               "queue_full_rejected": rejected,
+               "queue_full_retryable": retryable}
+    ok = (inv["ok"] and b.manager.resolve(vip) == "completed"
+          and rep["counters"]["shed"] >= 1 and rejected >= 1 and retryable
+          and all(r.error is not None and r.error.retryable
+                  for r in shed_reqs))
+    return ok, details
+
+
+def scenario_sigterm_drain(workdir):
+    """SIGTERM mid-flight. Invariant: admission closes with a retryable
+    'draining' ShedError, queued requests are shed, every in-flight
+    sequence resolves (completed within the drain budget), and the batcher
+    exits drained with the pool back to its initial state."""
+    import numpy as np
+
+    from deepspeed_tpu.serving import ShedError
+
+    b = _make_batcher(default_max_new_tokens=8, max_queue_depth=32,
+                      max_active_requests=4)
+    b.install_signal_handlers()
+    try:
+        rng = np.random.default_rng(2)
+        uids = [b.submit(rng.integers(0, 250, 40)) for _ in range(6)]
+        b.step()
+        b.step()                       # some in flight, some still queued
+        os.kill(os.getpid(), signal.SIGTERM)
+        b.pump(max_steps=100)
+        if not b.drained:
+            b.drain(timeout_s=60.0)
+        try:
+            b.submit(rng.integers(0, 250, 8))
+            admission_closed = False
+        except ShedError as e:
+            admission_closed = e.reason == "draining" and e.retryable
+    finally:
+        b.restore_signal_handlers()
+    rep = b.serving_report()
+    inv = _invariants(b, uids)
+    details = {"report": rep, "invariants": inv,
+               "states": {u: b.manager.resolve(u) for u in uids},
+               "admission_closed": admission_closed}
+    ok = (inv["ok"] and b.drained and admission_closed
+          and rep["counters"]["completed"] >= 1
+          and rep["health"] == "draining")
+    return ok, details
+
+
+SCENARIOS = {
+    "deadline-storm": scenario_deadline_storm,
+    "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
+    "sigterm-drain": scenario_sigterm_drain,
+}
+
+
+def run_scenario(name: str, workdir=None) -> dict:
+    """Run one drill; returns the verdict record (also usable from tests)."""
+    if name not in SCENARIOS:
+        raise SystemExit(f"unknown scenario {name!r} "
+                         f"(have: {sorted(SCENARIOS)})")
+    _fresh_injector()
+    t0 = time.time()
+    try:
+        ok, details = SCENARIOS[name](workdir)
+    finally:
+        _fresh_injector()
+    return {"scenario": name, "ok": ok,
+            "seconds": round(time.time() - t0, 2), "details": details}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", help="which drill to run")
+    ap.add_argument("--all", action="store_true", help="run every scenario")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, fn in SCENARIOS.items():
+            print(f"{name}: {fn.__doc__.splitlines()[0]}")
+        return 0
+    names = list(SCENARIOS) if args.all else (
+        [args.scenario] if args.scenario else None)
+    if not names:
+        ap.error("pass --scenario NAME, --all, or --list")
+    rc = 0
+    for name in names:
+        verdict = run_scenario(name)
+        print(json.dumps(verdict, indent=2, default=str))
+        if not verdict["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
