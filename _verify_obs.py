@@ -1,0 +1,178 @@
+"""PR 6 verification drive: unified observability layer through the PUBLIC API.
+
+User-style script (no internal test harness): JSON config → deepspeed_tpu
+.initialize → train with the registry/bridge/profile-trigger live, then the
+serving batcher with tracing + HTTP probes, then error probes. Run from
+/root/repo (cwd import; never clobber PYTHONPATH).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import csv  # noqa: E402
+import json  # noqa: E402
+import tempfile  # noqa: E402
+import urllib.request  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+
+checks = []
+
+
+def check(name, cond, detail=""):
+    checks.append((name, bool(cond), detail))
+    print(f"  [{'ok' if cond else 'FAIL'}] {name} {detail}")
+
+
+work = tempfile.mkdtemp(prefix="obs_verify_")
+cfg_path = os.path.join(work, "ds.json")
+prof_dir = os.path.join(work, "profiles")
+with open(cfg_path, "w") as f:
+    json.dump({
+        "train_batch_size": 16,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 2,
+        "monitor_config": {"csv_monitor": {
+            "enabled": True, "output_path": work, "job_name": "obsjob"}},
+        "observability": {
+            "enabled": True, "http_server": True, "http_port": 0,
+            "train_breakdown": True, "monitor_memory": True,
+            "flush_interval_steps": 2,
+            "profile": {"enabled": True, "output_dir": prof_dir,
+                        "capture_steps": 2, "rate_limit_s": 0.0,
+                        "warmup_steps": 2}},
+    }, f)
+
+print("== training surface (8-dev CPU mesh) ==")
+from deepspeed_tpu.models import TransformerLM, get_preset  # noqa: E402
+
+engine, _opt, _dl, _sched = deepspeed_tpu.initialize(
+    model=TransformerLM(get_preset("tiny")), config=cfg_path)
+check("mesh is 8-device", len(jax.devices()) == 8, str(jax.devices()[0]))
+
+rng = np.random.default_rng(0)
+
+
+def batch():
+    return {"input_ids": rng.integers(0, 250, (16, 16)),
+            "labels": rng.integers(0, 250, (16, 16))}
+
+
+for _ in range(3):
+    engine.train_batch(iter([batch()]))
+os.makedirs(prof_dir, exist_ok=True)
+rep = engine.observability_report()
+open(engine._profile_trigger.trigger_file, "w").close()  # arm from outside
+for _ in range(4):
+    engine.train_batch(iter([batch()]))
+
+check("observability_report enabled+breakdown",
+      rep["enabled"] and rep["breakdown"])
+check("metrics server url", rep["metrics_url"], rep["metrics_url"])
+body = urllib.request.urlopen(rep["metrics_url"] + "/metrics").read().decode()
+check("scrape has train_step_ms gauge", "train_step_ms" in body)
+check("scrape has train_fwd_ms breakdown", "train_fwd_ms" in body)
+check("scrape has resilience help text", "# TYPE" in body)
+hz = urllib.request.urlopen(rep["metrics_url"] + "/healthz")
+check("engine /healthz 200 (no health source)", hz.status == 200)
+
+from deepspeed_tpu.observability import get_registry  # noqa: E402
+
+snap = get_registry().snapshot()
+check("train/step_ms gauge populated",
+      snap["train/step_ms"]["series"][0]["value"] > 0,
+      f"{snap['train/step_ms']['series'][0]['value']:.2f}ms")
+check("train/loss gauge at steps_per_print",
+      snap["train/loss"]["series"][0]["value"] > 0)
+prof = engine._profile_trigger.report()
+check("profile capture fired once", prof["counters"]["captures"] == 1, prof)
+arts = [f for r, _d, fs in os.walk(prof_dir) for f in fs]
+check("xla trace artifacts on disk", len(arts) > 0, arts[:2])
+
+csv_dir = os.path.join(work, "obsjob")
+bridge_files = [f for f in os.listdir(csv_dir) if f.startswith("train_")]
+check("bridge->CSV train_* files", len(bridge_files) >= 5,
+      sorted(bridge_files)[:6])
+with open(os.path.join(csv_dir, "train_step_ms.csv")) as f:
+    rows = list(csv.reader(f))
+check("train_step_ms.csv header+rows",
+      rows[0] == ["step", "value", "time"] and len(rows) >= 2)
+
+ckpt_dir = os.path.join(work, "ckpt")
+engine.save_checkpoint(ckpt_dir)
+snap = get_registry().snapshot()
+check("train/checkpoint_ms set after save",
+      snap["train/checkpoint_ms"]["series"][0]["value"] > 0)
+engine.shutdown()
+try:
+    urllib.request.urlopen(rep["metrics_url"] + "/metrics", timeout=2)
+    check("metrics server closed on shutdown", False)
+except Exception:
+    check("metrics server closed on shutdown", True)
+
+print("== serving surface ==")
+from deepspeed_tpu.config.config import ServingConfig  # noqa: E402
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2  # noqa: E402
+from deepspeed_tpu.observability import MetricsRegistry  # noqa: E402
+from deepspeed_tpu.serving import ContinuousBatcher  # noqa: E402
+
+reg = MetricsRegistry()
+eng2 = InferenceEngineV2(TransformerLM(get_preset("tiny")), max_sequences=8,
+                         max_seq_len=128, block_size=16)
+eng2.enable_metrics(reg)
+b = ContinuousBatcher(eng2, ServingConfig(prefill_chunk=32,
+                                          default_max_new_tokens=4),
+                      registry=reg)
+uids = [b.submit(rng.integers(0, 250, 40)) for _ in range(3)]
+b.pump(max_steps=60)
+span = b.request_trace(uids[0])
+check("request span complete",
+      span["ttft_ms"] is not None and span["tpot_ms"] is not None
+      and span["e2e_ms"] is not None, span)
+check("ttft histogram populated", reg.get("serving/ttft_ms")
+      .series[()].count == 3)
+check("inference/* via enable_metrics incl. whole-prefill fast path",
+      reg.counter("inference/tokens").value >= 3 * 40)
+srv = b.serve_metrics_http()
+ready = urllib.request.urlopen(srv.url + "/readyz")
+check("batcher /readyz 200 when READY", ready.status == 200,
+      ready.read().decode())
+b.begin_drain("verify")
+try:
+    urllib.request.urlopen(srv.url + "/readyz", timeout=2)
+    check("/readyz 503 when DRAINING", False)
+except urllib.error.HTTPError as e:
+    check("/readyz 503 when DRAINING", e.code == 503)
+srv.close()
+b.drain(timeout_s=10)
+
+print("== error probes ==")
+try:
+    deepspeed_tpu.from_config({"train_batch_size": 8,
+                               "observability": {"capture_stepz": 1}})
+    check("typo'd observability key rejected", False)
+except Exception as e:
+    check("typo'd observability key rejected", "capture_stepz" in str(e),
+          str(e)[:90])
+try:
+    reg.histogram("bad/bounds", bounds=[3.0, 1.0])
+    check("non-monotone histogram bounds rejected", False)
+except ValueError as e:
+    check("non-monotone histogram bounds rejected", True, str(e)[:60])
+try:
+    reg.counter("serving/health")          # exists as a gauge
+    check("type conflict rejected", False)
+except ValueError:
+    check("type conflict rejected", True)
+
+fails = [c for c in checks if not c[1]]
+print(f"\n{len(checks) - len(fails)}/{len(checks)} checks passed")
+raise SystemExit(1 if fails else 0)

@@ -38,6 +38,12 @@ class CommsLogger:
         self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(lambda: defaultdict(list))
         self.counts: Dict[str, int] = defaultdict(int)
         self.bytes: Dict[str, float] = defaultdict(float)
+        # registry-export high-water marks (comm/<op>_bytes|_calls counters)
+        self._exported_calls: Dict[str, int] = {}
+        self._exported_bytes: Dict[str, float] = {}
+        # running sum: total_latency_s() is read once per training step, so
+        # it must be O(1), not a re-sum of every latency ever recorded
+        self._total_latency_s = 0.0
 
     def configure(self, config) -> None:
         self.enabled = config.enabled
@@ -57,9 +63,38 @@ class CommsLogger:
         self.bytes[op_name] += msg_bytes
         if latency_s is not None:
             self.comms_dict[op_name][msg_bytes].append(latency_s)
+            self._total_latency_s += latency_s
         if self.verbose:
             extra = f" lat={latency_s * 1e3:.3f}ms" if latency_s is not None else ""
             log_dist(f"comm: {log_name or op_name} size={_human_bytes(msg_bytes)}{extra}")
+
+    def total_latency_s(self) -> float:
+        """Running sum of every eagerly-timed collective latency (the
+        engine differentiates this across step boundaries for the
+        ``train/comm_ms`` gauge; traced ops contribute no latency). O(1):
+        this is read on the training hot path every step."""
+        return self._total_latency_s
+
+    def export_to_registry(self, registry=None) -> None:
+        """Emit per-op totals into the metrics registry as
+        ``comm/<op>_bytes`` and ``comm/<op>_calls`` counters, so comms
+        volume shows up on ``/metrics`` rather than only in log lines.
+        Delta-tracked: safe to call repeatedly (every ``log_summary``)."""
+        from deepspeed_tpu.observability import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        for op, count in self.counts.items():
+            key = op.replace("/", "_")
+            d_calls = count - self._exported_calls.get(op, 0)
+            if d_calls > 0:
+                reg.counter(f"comm/{key}_calls",
+                            "collective invocations").inc(d_calls)
+                self._exported_calls[op] = count
+            d_bytes = self.bytes[op] - self._exported_bytes.get(op, 0.0)
+            if d_bytes > 0:
+                reg.counter(f"comm/{key}_bytes",
+                            "collective payload bytes").inc(d_bytes)
+                self._exported_bytes[op] = self.bytes[op]
 
     def log_summary(self, show_straggler: bool = False) -> str:
         lines = ["Comm. Op            Count      Total Size     Avg Latency"]
@@ -71,12 +106,16 @@ class CommsLogger:
             lines.append(f"{op:<20}{count:<11}{_human_bytes(total):<15}{lat_s}")
         out = "\n".join(lines)
         log_dist(out)
+        self.export_to_registry()
         return out
 
     def reset(self) -> None:
         self.comms_dict.clear()
         self.counts.clear()
         self.bytes.clear()
+        self._exported_calls.clear()
+        self._exported_bytes.clear()
+        self._total_latency_s = 0.0
 
 
 # module-level singleton, mirroring the reference's global comms logger

@@ -525,6 +525,11 @@ class ServingConfig(DSTpuConfigModel):
     degraded_capacity_factor: float = 0.5
     drain_timeout_s: float = 30.0
     monitor_interval: int = 10        # serving steps between monitor writes
+    # per-request span tracing → serving/ttft_ms, serving/tpot_ms,
+    # serving/queue_wait_ms, serving/e2e_ms SLO histograms (a few clock
+    # reads per step; no device syncs). Gates ONLY the span histograms:
+    # lifecycle counters (terminals/sheds/rejects) always record.
+    trace_requests: bool = True
 
     @model_validator(mode="after")
     def _check(self):
@@ -542,6 +547,45 @@ class ServingConfig(DSTpuConfigModel):
             raise ValueError("serving: prefill_chunk and max_queue_depth "
                              "must be >= 1")
         return self
+
+
+class ProfileTriggerConfig(DSTpuConfigModel):
+    """``observability.profile``: on-demand ``jax.profiler`` capture armed
+    from outside a running job (trigger file or SIGUSR2) — see
+    :class:`~deepspeed_tpu.observability.ProfileTrigger`."""
+
+    enabled: bool = False
+    output_dir: str = "./xla_profiles"
+    # "" = <output_dir>/TRIGGER; touching the file arms one capture
+    trigger_file: str = ""
+    signal_enabled: bool = False      # SIGUSR2 arms a capture
+    capture_steps: int = 5            # steps of XLA trace per capture
+    rate_limit_s: float = 300.0       # at most one capture per this window
+    warmup_steps: int = 2             # never arm before this many boundaries
+                                      # (jit compile exemption)
+
+
+class ObservabilityConfig(DSTpuConfigModel):
+    """``observability`` section: the unified metrics/tracing/profiling
+    substrate (``deepspeed_tpu/observability``) — the process-wide
+    :class:`MetricsRegistry`, the ``/metrics`` + ``/healthz`` / ``/readyz``
+    HTTP exposition, the registry→monitor bridge, and the on-demand
+    profile trigger. ``enabled`` defaults True because the registry is
+    cheap-by-default (no device syncs; a handful of float ops per step
+    boundary); the HTTP server and breakdown timers stay opt-in."""
+
+    enabled: bool = True
+    http_server: bool = False         # stand up /metrics on engine init
+    http_host: str = "127.0.0.1"
+    http_port: int = 0                # 0 = ephemeral
+    flush_interval_steps: int = 0     # registry→monitor bridge cadence
+                                      # (0 = steps_per_print)
+    # per-step fwd/bwd/optimizer timer gauges (train/*_ms); also turned on
+    # by the legacy top-level wall_clock_breakdown flag
+    train_breakdown: bool = False
+    monitor_memory: bool = False      # host memory on the periodic speed log
+    profile: ProfileTriggerConfig = Field(
+        default_factory=ProfileTriggerConfig)
 
 
 class ResilienceConfig(DSTpuConfigModel):
@@ -592,6 +636,8 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    observability: ObservabilityConfig = Field(
+        default_factory=ObservabilityConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)

@@ -102,6 +102,7 @@ class InferenceEngineV2:
             self.param_sharding = None
         self.params = params
         self.timing: Dict[str, float] = {}
+        self._obs = None  # opt-in inference/* registry stream; enable_metrics
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
         if kv_dtype not in ("bf16", "int8", "int4"):
@@ -170,6 +171,25 @@ class InferenceEngineV2:
         from deepspeed_tpu.inference.quant import quantize_serving_params
 
         return quantize_serving_params(params, self.cfg, bits, self.mesh)
+
+    def enable_metrics(self, registry=None) -> None:
+        """Opt-in ``inference/*`` registry stream for the packed put path
+        (host-build and device+fetch latency histograms, token counter).
+        Off by default: the put loop is the decode hot path, and disabled
+        means literally one ``is None`` check per put."""
+        from deepspeed_tpu.observability import get_registry
+
+        r = registry if registry is not None else get_registry()
+        self._obs = {
+            "put_host_ms": r.histogram(
+                "inference/put_host_ms",
+                "put(): host batch building (ms)"),
+            "put_fetch_ms": r.histogram(
+                "inference/put_fetch_ms",
+                "put(): device step + logits D2H (ms)"),
+            "tokens": r.counter("inference/tokens",
+                                "tokens pushed through put()"),
+        }
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
     def query(self, uid: int, n_tokens: int) -> bool:
@@ -406,6 +426,12 @@ class InferenceEngineV2:
             "dispatch_ms": (t_disp - t_host) * 1e3,
             "fetch_ms": (time.perf_counter() - t_disp) * 1e3,
         }
+        if self._obs is not None:
+            # the whole-prompt fast path carries the TTFT-dominant puts —
+            # it must feed the same inference/* stream as the packed path
+            self._obs["put_host_ms"].observe(self.timing["host_ms"])
+            self._obs["put_fetch_ms"].observe(self.timing["fetch_ms"])
+            self._obs["tokens"].inc(float(sum(len(c) for c in chunks)))
         results: Dict[int, np.ndarray] = {}
         for i, (d, c) in enumerate(zip(descs, chunks)):
             results[d.uid] = out[i]
@@ -521,6 +547,10 @@ class InferenceEngineV2:
                 "dispatch_ms": (t_disp - t_host) * 1e3,
                 "fetch_ms": (t_fetch - t_disp) * 1e3,
             }
+            if self._obs is not None:
+                self._obs["put_host_ms"].observe(self.timing["host_ms"])
+                self._obs["put_fetch_ms"].observe(self.timing["fetch_ms"])
+                self._obs["tokens"].inc(float(sum(len(c) for c in chunks)))
             results: Dict[int, np.ndarray] = {}
             for i, (d, c) in enumerate(zip(descs, chunks)):
                 results[d.uid] = out[i]

@@ -18,23 +18,53 @@ class _Backend:
     def write_events(self, events: Iterable[Event]) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    def close(self) -> None:
+        pass
+
 
 class CSVMonitor(_Backend):
     def __init__(self, cfg):
         self.dir = cfg.output_path or "./csv_monitor"
         self.job = cfg.job_name
         os.makedirs(os.path.join(self.dir, self.job), exist_ok=True)
+        # tag → (handle, csv writer): one open per tag for the process
+        # lifetime instead of one open/close per event, flushed after each
+        # write_events batch so readers (tests, tail -f) see current rows
         self._files = {}
 
-    def write_events(self, events: Iterable[Event]) -> None:
-        for tag, value, step in events:
-            fname = os.path.join(self.dir, self.job, tag.replace("/", "_") + ".csv")
+    @staticmethod
+    def _sanitize(tag: str) -> str:
+        return tag.replace("/", "_").replace(" ", "_")
+
+    def _writer(self, tag: str):
+        entry = self._files.get(tag)
+        if entry is None:
+            fname = os.path.join(self.dir, self.job,
+                                 self._sanitize(tag) + ".csv")
             new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", "value", "time"])
-                w.writerow([step, value, time.time()])
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", "value", "time"])
+            entry = self._files[tag] = (f, w)
+        return entry
+
+    def write_events(self, events: Iterable[Event]) -> None:
+        touched = []
+        for tag, value, step in events:
+            f, w = self._writer(tag)
+            w.writerow([step, value, time.time()])
+            touched.append(f)
+        for f in touched:
+            f.flush()
+
+    def close(self) -> None:
+        for f, _w in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
 
 
 class TensorBoardMonitor(_Backend):
@@ -48,6 +78,9 @@ class TensorBoardMonitor(_Backend):
         for tag, value, step in events:
             self.writer.add_scalar(tag, value, step)
         self.writer.flush()
+
+    def close(self) -> None:
+        self.writer.close()
 
 
 class WandbMonitor(_Backend):
@@ -109,3 +142,12 @@ class MonitorMaster:
         events = list(events)
         for b in self.backends:
             b.write_events(events)
+
+    def close(self) -> None:
+        """Flush and release backend resources (cached CSV handles, writer
+        threads); safe to call more than once."""
+        for b in self.backends:
+            try:
+                b.close()
+            except Exception as e:  # teardown must not raise
+                logger.warning(f"monitor backend close failed: {e}")

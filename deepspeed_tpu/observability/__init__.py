@@ -1,0 +1,52 @@
+"""Unified observability layer: metrics registry, request tracing,
+``/metrics`` exposition, and on-demand XLA profiling.
+
+The reference DeepSpeed ships a real observability surface (monitor
+backends, ``CommsLogger``, flops profiler, ``SynchronizedWallClockTimer``);
+this package is the reproduction's equivalent substrate, designed for the
+serving stack the ROADMAP grows next:
+
+* :mod:`~deepspeed_tpu.observability.registry` —
+  :class:`MetricsRegistry` of typed Counter/Gauge/Histogram instruments
+  (fixed exponential buckets + streaming p50/p95/p99), rendered as
+  Prometheus text format and JSON;
+* :mod:`~deepspeed_tpu.observability.exposition` —
+  :class:`ObservabilityServer`: stdlib HTTP ``/metrics`` + ``/healthz`` /
+  ``/readyz`` probes mapped from the batcher's
+  STARTING/READY/DEGRADED/DRAINING health;
+* :mod:`~deepspeed_tpu.observability.tracing` — per-request serving spans
+  feeding the ``serving/ttft_ms`` / ``serving/tpot_ms`` /
+  ``serving/queue_wait_ms`` SLO histograms;
+* :mod:`~deepspeed_tpu.observability.profiler` — :class:`ProfileTrigger`:
+  trigger-file / SIGUSR2 → N-step ``jax.profiler`` capture, rate-limited
+  and compile-exempt, so a live slowdown can be profiled without a
+  restart;
+* :mod:`~deepspeed_tpu.observability.bridge` — :class:`MonitorBridge`:
+  periodic registry-delta flush through the existing ``MonitorMaster`` so
+  CSV/TensorBoard/wandb/comet dashboards keep working unchanged.
+
+Metric name schema: ``serving/*`` (request lifecycle + SLOs),
+``train/*`` (per-step breakdown), ``resilience/*`` (checkpoint/guard),
+``comm/*`` (collective volume), ``inference/*`` (engine put path).
+"""
+
+from deepspeed_tpu.observability.bridge import MonitorBridge
+from deepspeed_tpu.observability.exposition import (LIVE_STATES,
+                                                    READY_STATES,
+                                                    ObservabilityServer,
+                                                    probe_status)
+from deepspeed_tpu.observability.profiler import ProfileTrigger
+from deepspeed_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                                  HistogramWindow,
+                                                  MetricsRegistry,
+                                                  exponential_bounds,
+                                                  get_registry, set_registry)
+from deepspeed_tpu.observability.tracing import HEALTH_CODES, ServingMetrics
+
+__all__ = [
+    "Counter", "Gauge", "HEALTH_CODES", "Histogram", "HistogramWindow",
+    "LIVE_STATES", "MetricsRegistry", "MonitorBridge",
+    "ObservabilityServer", "ProfileTrigger", "READY_STATES",
+    "ServingMetrics", "exponential_bounds", "get_registry", "probe_status",
+    "set_registry",
+]

@@ -1,0 +1,147 @@
+"""Stdlib HTTP exposition: ``/metrics``, ``/healthz``, ``/readyz``.
+
+This is the scrape surface the future network-facing serving front-end
+mounts directly; until that exists it runs as a sidecar thread next to a
+:class:`~deepspeed_tpu.serving.batcher.ContinuousBatcher` or a training
+engine. No third-party dependency — ``http.server`` on a daemon thread.
+
+Probe semantics (mapped from the batcher's health state machine):
+
+=========  ==================  ==================
+state      ``/healthz`` (live)  ``/readyz`` (ready)
+=========  ==================  ==================
+starting   200                 503 (do not route yet)
+ready      200                 200
+degraded   200                 200 (reduced capacity is still capacity)
+draining   200 (let it finish) 503 (stop routing; don't kill)
+=========  ==================  ==================
+
+A DRAINING replica is deliberately live-but-not-ready: an orchestrator
+that kills on liveness would destroy the in-flight sequences the drain
+exists to finish, while readiness-503 makes the router move new traffic
+away — exactly the ROADMAP's drain-aware rebalancing contract.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from deepspeed_tpu.observability.registry import (MetricsRegistry,
+                                                  get_registry)
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["READY_STATES", "LIVE_STATES", "ObservabilityServer",
+           "probe_status"]
+
+#: batcher health states that answer 200 on /readyz
+READY_STATES = frozenset({"ready", "degraded"})
+#: batcher health states that answer 200 on /healthz (all of them — a
+#: process that answers HTTP at all is live; liveness fails by not answering)
+LIVE_STATES = frozenset({"starting", "ready", "degraded", "draining"})
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def probe_status(health: Optional[str]) -> dict:
+    """(live, ready) booleans for a health state string (None = no health
+    source wired → both probes pass; a bare metrics sidecar is never the
+    reason a pod gets rescheduled)."""
+    if health is None:
+        return {"health": None, "live": True, "ready": True}
+    h = str(health).lower()
+    return {"health": h, "live": h in LIVE_STATES, "ready": h in READY_STATES}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dstpu-obs/1"
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv = self.server
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, srv.registry.render_prometheus(),
+                           PROM_CONTENT_TYPE)
+            elif path == "/metrics.json":
+                self._send(200, srv.registry.render_json(),
+                           "application/json")
+            elif path in ("/healthz", "/readyz"):
+                st = probe_status(srv.health_fn()
+                                  if srv.health_fn is not None else None)
+                ok = st["live"] if path == "/healthz" else st["ready"]
+                self._send(200 if ok else 503, json.dumps(st),
+                           "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except Exception as e:  # never take the serving process down
+            try:
+                self._send(500, f"scrape error: {e}\n", "text/plain")
+            except OSError:
+                pass
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+
+class ObservabilityServer:
+    """Threaded exposition server bound to ``host:port`` (port 0 = ephemeral).
+
+    ``health_fn`` is any zero-arg callable returning the current health
+    state string; :meth:`for_batcher` wires it to a ``ContinuousBatcher``.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], str]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry if registry is not None else get_registry()
+        self.health_fn = health_fn
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.registry = self.registry
+        self._httpd.health_fn = health_fn
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def for_batcher(cls, batcher, registry=None, **kw) -> "ObservabilityServer":
+        """Probes track the batcher's STARTING/READY/DEGRADED/DRAINING."""
+        srv = cls(registry=registry, health_fn=lambda: batcher.health, **kw)
+        return srv
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ObservabilityServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="dstpu-obs-http",
+                daemon=True)
+            self._thread.start()
+            logger.info(f"observability: /metrics /healthz /readyz at "
+                        f"{self.url}")
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,109 @@
+"""Serving request tracing: per-request spans → SLO histograms.
+
+Every admitted request carries a span (timestamps stamped on its
+:class:`~deepspeed_tpu.serving.request.ServeRequest` by the manager and
+batcher): submit → admit (queue wait) → first prefill → first token (TTFT)
+→ per-token decode (TPOT) → terminal. :class:`ServingMetrics` is the
+bundle of registry instruments those spans feed — created once per batcher
+so the hot path holds direct instrument references (no name lookups per
+token).
+
+Metric schema (all under ``serving/``):
+
+* ``serving/ttft_ms`` (histogram) — submit → first generated token;
+* ``serving/tpot_ms`` (histogram) — inter-token gap while decoding;
+* ``serving/queue_wait_ms`` (histogram) — submit → admission;
+* ``serving/step_ms`` (histogram) — one batcher step wall clock (replaces
+  the bespoke 256-sample deque);
+* ``serving/e2e_ms`` (histogram) — submit → terminal, completed only;
+* ``serving/requests`` (counter, label ``terminal=``) — terminal rates;
+* ``serving/shed_total`` (counter, label ``reason=``) — shed rate by cause;
+* ``serving/rejected_total`` (counter, label ``reason=``) — admission
+  refusals (queue_full / draining);
+* gauges: ``serving/health`` (0=starting 1=ready 2=degraded 3=draining),
+  ``serving/queue_depth``, ``serving/active_requests``,
+  ``serving/kv_occupancy``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deepspeed_tpu.observability.registry import (MetricsRegistry,
+                                                  exponential_bounds,
+                                                  get_registry)
+
+__all__ = ["ServingMetrics", "HEALTH_CODES"]
+
+HEALTH_CODES = {"starting": 0, "ready": 1, "degraded": 2, "draining": 3}
+
+# ms-unit latency bounds: 0.25 ms … ~33 s
+_LAT_BOUNDS = exponential_bounds(0.25, 2.0, 18)
+
+
+class ServingMetrics:
+    """Instrument handles for the serving layer (one per batcher)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.registry = r
+        # gates the per-token span histograms (ttft/tpot/queue_wait/e2e)
+        # only — lifecycle counters always record (one bump per terminal
+        # transition is not hot-path work)
+        self.spans_enabled = True
+        self.ttft_ms = r.histogram(
+            "serving/ttft_ms", "submit -> first generated token (ms)",
+            bounds=_LAT_BOUNDS)
+        self.tpot_ms = r.histogram(
+            "serving/tpot_ms", "inter-token decode gap (ms)",
+            bounds=_LAT_BOUNDS)
+        self.queue_wait_ms = r.histogram(
+            "serving/queue_wait_ms", "submit -> admission (ms)",
+            bounds=_LAT_BOUNDS)
+        self.step_ms = r.histogram(
+            "serving/step_ms", "one serving step wall clock (ms)",
+            bounds=_LAT_BOUNDS)
+        self.e2e_ms = r.histogram(
+            "serving/e2e_ms", "submit -> completion (ms, completed only)",
+            bounds=_LAT_BOUNDS)
+        self.health = r.gauge(
+            "serving/health",
+            "0=starting 1=ready 2=degraded 3=draining")
+        self.queue_depth = r.gauge("serving/queue_depth",
+                                   "requests waiting for admission")
+        self.active_requests = r.gauge("serving/active_requests",
+                                       "requests on the engine")
+        self.kv_occupancy = r.gauge("serving/kv_occupancy",
+                                    "paged KV pool occupancy [0, 1]")
+        self._terminals: Dict[str, object] = {}
+        self._sheds: Dict[str, object] = {}
+        self._rejects: Dict[str, object] = {}
+
+    # label-set children are created on first use and cached: terminal
+    # states and shed reasons are small closed sets, so the dict stays tiny
+    def terminal(self, state: str):
+        c = self._terminals.get(state)
+        if c is None:
+            c = self._terminals[state] = self.registry.counter(
+                "serving/requests", "requests by terminal state",
+                labels={"terminal": state})
+        return c
+
+    def shed(self, reason: str):
+        c = self._sheds.get(reason)
+        if c is None:
+            c = self._sheds[reason] = self.registry.counter(
+                "serving/shed_total", "sheds by reason",
+                labels={"reason": reason})
+        return c
+
+    def rejected(self, reason: str):
+        c = self._rejects.get(reason)
+        if c is None:
+            c = self._rejects[reason] = self.registry.counter(
+                "serving/rejected_total", "admission refusals by reason",
+                labels={"reason": reason})
+        return c
+
+    def set_health(self, health: str) -> None:
+        self.health.set(float(HEALTH_CODES.get(health, -1)))

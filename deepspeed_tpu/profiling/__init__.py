@@ -7,5 +7,8 @@ program, and ``jax.profiler`` produces xprof traces (the NVTX/nsys analog).
 """
 
 from deepspeed_tpu.profiling.flops_profiler import (  # noqa: F401
-    FlopsProfiler, per_module_profile, profile_fn,
+    FlopsProfiler, per_module_profile, profile_fn, start_trace, stop_trace,
 )
+# On-demand, rate-limited capture of the SAME jax.profiler traces from a
+# RUNNING job (trigger file / SIGUSR2) lives in the observability layer:
+from deepspeed_tpu.observability.profiler import ProfileTrigger  # noqa: F401

@@ -247,6 +247,7 @@ class CheckpointManager:
                     self.async_stats["commits"] += 1
                     self.async_stats["last_latency_s"] = dt
                     self.async_stats["total_latency_s"] += dt
+                    self._observe_save_latency(dt)
                     log_dist(f"async checkpoint committed: {path} "
                              f"({dt:.2f}s stage→commit)")
                 except BaseException as e:
@@ -267,8 +268,21 @@ class CheckpointManager:
         else:
             _commit()
             self.counters["emergency_saves" if emergency else "saves"] += 1
+            self._observe_save_latency(time.monotonic() - t0)
             log_dist(f"checkpoint committed: {path} (emergency={emergency})")
         return path
+
+    @staticmethod
+    def _observe_save_latency(seconds: float) -> None:
+        """Stream save latency into the metrics registry
+        (``resilience/ckpt_save_ms``) so checkpoint cost is scrapeable next
+        to the ``train/*`` step breakdown."""
+        from deepspeed_tpu.observability import get_registry
+
+        get_registry().histogram(
+            "resilience/ckpt_save_ms",
+            "checkpoint save wall clock, stage->commit").observe(
+                seconds * 1e3)
 
     def drain(self, raise_on_error: bool = True) -> None:
         """Block until the in-flight async commit (if any) finishes.

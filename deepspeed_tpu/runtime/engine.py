@@ -187,7 +187,8 @@ class DeepSpeedTpuEngine:
         self._world_params = num_params(param_shapes)
         self.tput_timer = ThroughputTimer(
             batch_size=int(self.config.train_batch_size),
-            steps_per_output=config.steps_per_print)
+            steps_per_output=config.steps_per_print,
+            monitor_memory=config.observability.monitor_memory)
         self.monitor = None
         if any(m.enabled for m in (config.monitor_config.tensorboard,
                                    config.monitor_config.wandb,
@@ -195,6 +196,7 @@ class DeepSpeedTpuEngine:
             from deepspeed_tpu.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(config.monitor_config)
+        self._configure_observability(config)
 
         # ---- data efficiency (curriculum sampling/truncation + random-LTD) --
         de = config.data_efficiency
@@ -668,6 +670,8 @@ class DeepSpeedTpuEngine:
     def forward(self, batch, *args, **kwargs):
         """Compute micro-batch loss (and, functionally, its grads) — engine.py:2675."""
         self.tput_timer.start()
+        if self._breakdown:
+            self.wall_timers("fwd").start(synchronize=False)
         if self._ltd_cfg is not None and self._grad_acc_count == 0:
             self._update_random_ltd()  # only at accumulation boundaries
         batch = self._apply_curriculum(batch)
@@ -685,6 +689,10 @@ class DeepSpeedTpuEngine:
             loss, grads = self._fwd_bwd(p_in, batch, self.scaler_state["scale"])
         self._pending = grads
         self._last_loss = loss
+        if self._breakdown:
+            # record=False: the per-micro-step records list is unbounded;
+            # the gauge only needs elapsed(reset=True) at the boundary
+            self.wall_timers("fwd").stop(record=False, synchronize=False)
         return loss
 
     __call__ = forward
@@ -693,6 +701,8 @@ class DeepSpeedTpuEngine:
         """Fold the pending micro-batch grads into the accumulator — engine.py:3066."""
         if self._pending is None:
             raise RuntimeError("backward() called before forward()")
+        if self._breakdown:
+            self.wall_timers("bwd").start(synchronize=False)
         with jax.sharding.set_mesh(self.mesh):
             if self._grad_acc is None or self._grad_acc_count == 0:
                 self._grad_acc = self._pending
@@ -701,6 +711,8 @@ class DeepSpeedTpuEngine:
         self._pending = None
         self._grad_acc_count += 1
         self.micro_steps += 1
+        if self._breakdown:
+            self.wall_timers("bwd").stop(record=False, synchronize=False)
         return loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -750,6 +762,7 @@ class DeepSpeedTpuEngine:
         # applying) a step whose loss/grads are non-finite
         if self._guard is not None and self._guard.intercept():
             return
+        self._opt_t0 = time.perf_counter()
         if self._offload is not None:
             ga = float(self.config.gradient_accumulation_steps)
             denom = ga * float(self.scaler_state["scale"])  # unscale fp16 loss scale
@@ -825,6 +838,12 @@ class DeepSpeedTpuEngine:
         self._grad_acc = None
         self._grad_acc_count = 0
         self._last_gnorm = gnorm
+        t0 = getattr(self, "_opt_t0", None)
+        if t0 is not None:
+            # imperative path only: the fused paths bury the optimizer
+            # inside one jit, where only train/step_ms is meaningful
+            self._opt_ms = (time.perf_counter() - t0) * 1e3
+            self._opt_t0 = None
         self._commit_step(bool(skipped))
         self.tput_timer.stop(global_step=True, report_speed=True)
 
@@ -848,6 +867,8 @@ class DeepSpeedTpuEngine:
             if self.global_steps and \
                     self.global_steps % self.config.steps_per_print == 0:
                 self.monitor.write_events(self._resilience_events())
+        if self._obs is not None:
+            self._emit_train_metrics()
         if self._heartbeat is not None:
             self._heartbeat.notify_step(self.global_steps)
         self._resilience_step_boundary()
@@ -1077,11 +1098,17 @@ class DeepSpeedTpuEngine:
 
         if self._offload is not None and self._offload.overlap:
             self._collect_offload()  # drain the async step before snapshotting
+        t0 = time.perf_counter()
         if self._resilience_enabled():
             self._resilience_manager(save_dir).save(
                 self, tag=tag, client_state=client_state or {})
-            return
-        save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
+        else:
+            save_checkpoint(self, save_dir, tag=tag,
+                            client_state=client_state or {})
+        if self._obs is not None:
+            # async saves report their stage time here; commit latency
+            # streams separately via resilience/ckpt_save_ms
+            self._obs["checkpoint_ms"].set((time.perf_counter() - t0) * 1e3)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True, **kw):
@@ -1097,6 +1124,128 @@ class DeepSpeedTpuEngine:
                                   load_optimizer_states=load_optimizer_states)
         self._refresh_hpz()  # secondary copy is derived state, not checkpointed
         return out
+
+    # ------------------------------------------------------------------
+    # observability surface
+    # ------------------------------------------------------------------
+    def _configure_observability(self, config) -> None:
+        """Registry gauges for the per-step breakdown, the registry→monitor
+        bridge, the optional ``/metrics`` server, and the on-demand profile
+        trigger. Cheap-by-default: with ``observability.enabled`` the per
+        step cost is a handful of host float ops; the breakdown timers are
+        opt-in and never add a device sync (``synchronize=False`` — host
+        timestamps bound dispatch, and the paths that already sync, e.g.
+        ``float(loss)`` in the monitor write, stay the only syncs)."""
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+        ocfg = config.observability
+        self.wall_timers = SynchronizedWallClockTimer()
+        self._obs = None
+        self._obs_bridge = None
+        self._obs_server = None
+        self._profile_trigger = None
+        self._breakdown = bool(ocfg.enabled and (
+            ocfg.train_breakdown or config.wall_clock_breakdown))
+        self._opt_ms: Optional[float] = None
+        self._last_commit_t: Optional[float] = None
+        # baseline NOW, not 0: the comms logger is a process singleton, and
+        # latency recorded before this engine existed (a previous engine,
+        # init-time collectives) must not land in our first step's delta
+        from deepspeed_tpu.comm.logger import comms_logger
+
+        self._comm_lat_base = comms_logger.total_latency_s()
+        if not ocfg.enabled:
+            return
+        from deepspeed_tpu.observability import (MonitorBridge,
+                                                 ObservabilityServer,
+                                                 ProfileTrigger, get_registry)
+
+        reg = get_registry()
+        g = reg.gauge
+        self._obs = {
+            "step_ms": g("train/step_ms", "wall clock between step commits"),
+            "fwd_ms": g("train/fwd_ms", "forward dispatch (breakdown mode)"),
+            "bwd_ms": g("train/bwd_ms", "grad fold (breakdown mode)"),
+            "optimizer_ms": g("train/optimizer_ms", "optimizer apply"),
+            "comm_ms": g("train/comm_ms",
+                         "eager host-collective time this step"),
+            "checkpoint_ms": g("train/checkpoint_ms",
+                               "last checkpoint save wall clock"),
+            "loss": g("train/loss", "last reported loss"),
+            "lr": g("train/lr", "current learning rate"),
+            "steps": g("train/steps", "global optimizer steps"),
+            "samples": g("train/samples", "global samples consumed"),
+            "skipped_steps": g("train/skipped_steps",
+                               "overflow/guard-skipped steps"),
+        }
+        if self.monitor is not None:
+            # serving/* belongs to a co-resident batcher's bridge (its own
+            # step axis); flushing it here too would interleave conflicting
+            # step keys into the same CSV/TB series
+            self._obs_bridge = MonitorBridge(self.monitor, reg,
+                                             exclude=("serving/",))
+        if ocfg.profile.enabled:
+            self._profile_trigger = ProfileTrigger.from_config(ocfg.profile)
+            if ocfg.profile.signal_enabled:
+                self._profile_trigger.install_signal_handler()
+        if ocfg.http_server and jax.process_index() == 0:
+            self._obs_server = ObservabilityServer(
+                reg, host=ocfg.http_host, port=ocfg.http_port).start()
+
+    def _emit_train_metrics(self) -> None:
+        """Per-commit registry update (host floats only — the one forced
+        device read, ``float(loss)``, happens at ``steps_per_print`` cadence
+        where ``_report_progress`` already pays it)."""
+        o = self._obs
+        now = time.perf_counter()
+        if self._last_commit_t is not None:
+            o["step_ms"].set((now - self._last_commit_t) * 1e3)
+        self._last_commit_t = now
+        o["steps"].set(float(self.global_steps))
+        o["samples"].set(float(self.global_samples))
+        o["skipped_steps"].set(float(self.skipped_steps))
+        if self._opt_ms is not None:
+            o["optimizer_ms"].set(self._opt_ms)
+            self._opt_ms = None
+        if self._breakdown:
+            for timer, key in (("fwd", "fwd_ms"), ("bwd", "bwd_ms")):
+                if self.wall_timers.has(timer):
+                    o[key].set(self.wall_timers(timer).elapsed(reset=True)
+                               * 1e3)
+        from deepspeed_tpu.comm.logger import comms_logger
+
+        lat = comms_logger.total_latency_s()
+        # a comms_logger.reset() mid-run rewinds the total below our base;
+        # rebase instead of reporting a negative step delta
+        o["comm_ms"].set(max(0.0, lat - self._comm_lat_base) * 1e3)
+        self._comm_lat_base = lat
+        at_print = self.global_steps and \
+            self.global_steps % self.config.steps_per_print == 0
+        if at_print:
+            if self._last_loss is not None:
+                o["loss"].set(float(self._last_loss))
+            o["lr"].set(float(self.get_lr()[0]))
+        if self._profile_trigger is not None:
+            self._profile_trigger.check(self.global_steps)
+        if self._obs_bridge is not None:
+            interval = (self.config.observability.flush_interval_steps
+                        or self.config.steps_per_print)
+            if self.global_steps and self.global_steps % interval == 0:
+                self._obs_bridge.flush(self.global_samples)
+
+    def observability_report(self) -> Dict[str, Any]:
+        """One-call snapshot of the observability surface itself."""
+        from deepspeed_tpu.observability import get_registry
+
+        return {
+            "enabled": self._obs is not None,
+            "breakdown": self._breakdown,
+            "metrics_url": (self._obs_server.url
+                            if self._obs_server is not None else None),
+            "profile": (self._profile_trigger.report()
+                        if self._profile_trigger is not None else None),
+            "families": sorted(f.name for f in get_registry().collect()),
+        }
 
     # ------------------------------------------------------------------
     # resilience surface
@@ -1246,6 +1395,15 @@ class DeepSpeedTpuEngine:
             self._watchdog.stop()
         if self._heartbeat is not None:
             self._heartbeat.stop()
+        if self._profile_trigger is not None:
+            self._profile_trigger.close()
+        if self._obs_server is not None:
+            self._obs_server.close()
+            self._obs_server = None
+        if self.monitor is not None:
+            # release cached CSV handles / writer threads (backends reopen
+            # on the next write, so a late event after shutdown still lands)
+            self.monitor.close()
 
     def _resilience_manager(self, ckpt_dir: str):
         """One CheckpointManager per checkpoint directory; the first becomes

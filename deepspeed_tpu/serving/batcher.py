@@ -28,9 +28,15 @@ admission closes, queued requests are shed retryably, in-flight sequences
 finish (or are abandoned at ``drain_timeout_s``), then the loop exits —
 the serving analog of the training engine's preemption-safe shutdown.
 
-Counters, queue/KV occupancy, and p50/p99 step latency stream through the
-monitor backends under ``serving/*``; :meth:`serving_report` mirrors the
-training engine's ``resilience_report()``.
+Observability: every request carries a span (admit → queue-wait → TTFT →
+per-token decode → terminal) feeding the ``serving/ttft_ms`` /
+``serving/tpot_ms`` / ``serving/queue_wait_ms`` SLO histograms in the
+process :class:`~deepspeed_tpu.observability.MetricsRegistry` (scrapeable
+at ``/metrics`` via :meth:`serve_metrics_http`, with ``/healthz`` /
+``/readyz`` probes mapped from the health state machine); counters and
+queue/KV occupancy also stream through the monitor backends under
+``serving/*``; :meth:`serving_report` mirrors the training engine's
+``resilience_report()``.
 """
 
 from __future__ import annotations
@@ -44,6 +50,8 @@ from typing import Callable, Deque, Dict, List, Optional
 import numpy as np
 
 from deepspeed_tpu.inference.ragged import CapacityError
+from deepspeed_tpu.observability import (HEALTH_CODES, HistogramWindow,
+                                         MonitorBridge, ServingMetrics)
 from deepspeed_tpu.resilience.faults import InjectedIOError, get_injector
 from deepspeed_tpu.serving.manager import RequestManager
 from deepspeed_tpu.serving.request import DECODING, PREFILLING, ServeRequest
@@ -58,13 +66,16 @@ STARTING, READY, DEGRADED, DRAINING = ("starting", "ready", "degraded",
 class ContinuousBatcher:
     def __init__(self, engine, config=None, monitor=None,
                  clock: Callable[[], float] = time.monotonic,
-                 manager: Optional[RequestManager] = None):
+                 manager: Optional[RequestManager] = None,
+                 registry=None):
         """``engine`` is an :class:`InferenceEngineV2` (packed+paged);
         ``config`` a :class:`~deepspeed_tpu.config.config.ServingConfig`
         (None = defaults); ``monitor`` an optional
         :class:`~deepspeed_tpu.monitor.MonitorMaster` for the ``serving/*``
-        stream. ``clock`` is injectable so deadline tests are
-        deterministic."""
+        stream; ``registry`` an optional
+        :class:`~deepspeed_tpu.observability.MetricsRegistry` (None = the
+        process-wide default that ``/metrics`` exposes). ``clock`` is
+        injectable so deadline tests are deterministic."""
         if not getattr(engine, "packed", False):
             raise ValueError("ContinuousBatcher needs the packed paged "
                              "engine (InferenceEngineV2(packed=True))")
@@ -74,12 +85,24 @@ class ContinuousBatcher:
         self.cfg = config if config is not None else ServingConfig()
         self.monitor = monitor
         self.clock = clock
-        self.manager = manager if manager is not None else RequestManager(
-            max_queue_depth=self.cfg.max_queue_depth,
-            default_max_new_tokens=self.cfg.default_max_new_tokens,
-            default_deadline_s=self.cfg.default_deadline_s,
-            retry_after_s=self.cfg.retry_after_s,
-            clock=clock)
+        self.metrics = ServingMetrics(registry)
+        # trace_requests gates ONLY the per-token span histograms
+        # (ttft/tpot/queue_wait/e2e); lifecycle counters — terminals,
+        # sheds, rejects — are one bump per transition and must keep
+        # recording, or an overload incident goes invisible on /metrics
+        self._trace = bool(self.cfg.trace_requests)
+        self.metrics.spans_enabled = self._trace
+        if manager is not None:
+            self.manager = manager
+            if manager.metrics is None:
+                manager.metrics = self.metrics
+        else:
+            self.manager = RequestManager(
+                max_queue_depth=self.cfg.max_queue_depth,
+                default_max_new_tokens=self.cfg.default_max_new_tokens,
+                default_deadline_s=self.cfg.default_deadline_s,
+                retry_after_s=self.cfg.retry_after_s,
+                clock=clock, metrics=self.metrics)
         self.manager.release_fn = lambda uids: self.engine.flush(uids)
         self.health = STARTING
         self.drained = False
@@ -87,9 +110,24 @@ class ContinuousBatcher:
         self.steps = 0
         self._drain_requested = threading.Event()
         self._prev_sigterm = None
+        # arm via trigger-file/SIGUSR2 for a live XLA capture (ProfileTrigger;
+        # checked once per step when set — see tools/obs_drill.py)
+        self.profile_trigger = None
+        # the bridge flushes the registry-native families; the four gauges
+        # _serving_events already streams under the same tags are excluded
+        # so one flush never writes a tag twice
+        self._bridge = (MonitorBridge(
+            monitor, self.metrics.registry, prefix="serving/",
+            exclude=("serving/health", "serving/queue_depth",
+                     "serving/active_requests", "serving/kv_occupancy"))
+            if monitor is not None else None)
         # sliding window of step outcomes (True = failed) drives DEGRADED
         self._failures: Deque[bool] = deque(maxlen=self.cfg.failure_window)
-        self._latencies_ms: Deque[float] = deque(maxlen=256)
+        # recent-window view of step latency for the report/monitor stream:
+        # lifetime percentiles over a long-lived replica would bury a fresh
+        # regression under millions of old fast samples (the /metrics
+        # histogram stays cumulative — Prometheus windows it with rate())
+        self._step_window = HistogramWindow(self.metrics.step_ms)
         self.counters: Dict[str, int] = {
             "engine_steps": 0, "idle_steps": 0, "step_failures": 0,
             "decode_tokens": 0, "prefill_tokens": 0, "degraded_entries": 0,
@@ -247,6 +285,16 @@ class ContinuousBatcher:
             self.counters["decode_tokens"] += 1
         nxt = int(np.argmax(np.asarray(logits)))
         req.generated.append(nxt)
+        if self._trace:
+            now = self.clock()
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self.metrics.ttft_ms.observe(
+                    (now - req.submitted_at) * 1e3)
+            else:
+                self.metrics.tpot_ms.observe(
+                    (now - req.last_token_at) * 1e3)
+            req.last_token_at = now
         if self.cfg.eos_token_id is not None \
                 and nxt == self.cfg.eos_token_id:
             self.manager.complete(req, "eos")
@@ -308,15 +356,21 @@ class ContinuousBatcher:
                 self._advance(r, len(c), logits)
         self.steps += 1
         self.counters["engine_steps"] += 1
-        self._latencies_ms.append((self.clock() - t0) * 1e3)
+        self.metrics.step_ms.observe((self.clock() - t0) * 1e3)
+        if self.steps % 256 == 0:      # same horizon as the old 256-deque
+            self._step_window.roll()
         if failed is not None:
             self.counters["step_failures"] += 1
             logger.warning(f"serving: step {self.steps} failed ({failed})")
         self._failures.append(failed is not None)
         self._update_health()
+        self._update_gauges()
+        if self.profile_trigger is not None:
+            self.profile_trigger.check(self.steps)
         if self.monitor is not None \
                 and self.steps % max(1, self.cfg.monitor_interval) == 0:
             self.monitor.write_events(self._serving_events())
+            self._bridge.flush(self.steps)
         return True
 
     def pump(self, max_steps: Optional[int] = None) -> int:
@@ -399,8 +453,10 @@ class ContinuousBatcher:
         for req in list(self.manager.active.values()):
             self.manager.shed(req, "drain_timeout")
         self.drained = True
+        self._update_gauges()
         if self.monitor is not None:
             self.monitor.write_events(self._serving_events())
+            self._bridge.flush(self.steps)
         logger.warning(f"serving: drained ({self.drain_reason}); "
                        f"completed={self.manager.counters['completed']} "
                        f"shed={self.manager.counters['shed']} "
@@ -410,16 +466,47 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+    def _update_gauges(self) -> None:
+        """Registry gauges refreshed once per step (host floats only)."""
+        mx = self.metrics
+        mx.set_health(self.health)
+        mx.queue_depth.set(float(self.manager.queue_depth))
+        mx.active_requests.set(float(len(self.manager.active)))
+        mx.kv_occupancy.set(float(self.kv_occupancy))
+
     def _latency_pct(self, q: float) -> float:
-        if not self._latencies_ms:
-            return 0.0
-        return float(np.percentile(np.asarray(self._latencies_ms), q))
+        return float(self._step_window.percentile(q))
+
+    def serve_metrics_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Mount ``/metrics`` + ``/healthz`` / ``/readyz`` for this batcher
+        (readiness follows READY/DEGRADED; a DRAINING replica reports
+        not-ready but stays live). Returns the started
+        :class:`~deepspeed_tpu.observability.ObservabilityServer` — the
+        future HTTP front-end mounts the same handlers."""
+        from deepspeed_tpu.observability import ObservabilityServer
+
+        return ObservabilityServer.for_batcher(
+            self, registry=self.metrics.registry, host=host,
+            port=port).start()
+
+    def request_trace(self, uid: int) -> Optional[Dict]:
+        """Span record for any uid ever submitted (see ServeRequest.span)."""
+        return self.manager.trace(uid)
 
     def serving_report(self) -> Dict:
         """The serving mirror of the training engine's
         ``resilience_report()`` — everything a drill or dashboard needs in
         one dict."""
         m = self.manager
+        slo = {
+            name: {"p50": round(h.percentile(50), 3),
+                   "p95": round(h.percentile(95), 3),
+                   "p99": round(h.percentile(99), 3),
+                   "samples": h.count}
+            for name, h in (("ttft", self.metrics.ttft_ms),
+                            ("tpot", self.metrics.tpot_ms),
+                            ("queue_wait", self.metrics.queue_wait_ms))
+        }
         return {
             "health": self.health,
             "drained": self.drained,
@@ -435,17 +522,20 @@ class ContinuousBatcher:
                    "occupancy": round(self.kv_occupancy, 4)},
             "latency_ms": {"p50": round(self._latency_pct(50), 3),
                            "p99": round(self._latency_pct(99), 3),
-                           "samples": len(self._latencies_ms)},
+                           "samples": self._step_window.count},
+            "slo_ms": slo,
         }
 
-    _HEALTH_CODES = {STARTING: 0, READY: 1, DEGRADED: 2, DRAINING: 3}
+    # one health encoding for the monitor stream AND the registry gauge —
+    # observability.tracing.HEALTH_CODES is the single source of truth
+    _HEALTH_CODES = HEALTH_CODES
 
     def _serving_events(self):
         """The ``serving/*`` monitor stream (one gauge per counter), keyed
         by serving step the way training events key on samples."""
         s = self.steps
         m = self.manager
-        events = [("serving/health", float(self._HEALTH_CODES[self.health]),
+        events = [("serving/health", float(HEALTH_CODES[self.health]),
                    s),
                   ("serving/queue_depth", float(m.queue_depth), s),
                   ("serving/active_requests", float(len(m.active)), s),

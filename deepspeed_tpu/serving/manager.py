@@ -34,13 +34,18 @@ class RequestManager:
                  default_deadline_s: Optional[float] = None,
                  retry_after_s: float = 1.0,
                  release_fn: Optional[Callable[[Sequence[int]], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
         self.max_queue_depth = int(max_queue_depth)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_deadline_s = default_deadline_s
         self.retry_after_s = float(retry_after_s)
         self.release_fn = release_fn
         self.clock = clock
+        # optional ServingMetrics: terminal/shed/reject counters + the
+        # queue-wait and end-to-end SLO histograms ride the same lifecycle
+        # transitions that keep the ledger, so the two can never disagree
+        self.metrics = metrics
         self.queue: Deque[ServeRequest] = deque()
         self.active: Dict[int, ServeRequest] = {}   # admitted, on the engine
         self.done: Dict[int, ServeRequest] = {}     # terminal ledger
@@ -64,11 +69,15 @@ class RequestManager:
         self.counters["submitted"] += 1
         if self._closed_reason is not None:
             self.counters["rejected"] += 1
+            if self.metrics is not None:
+                self.metrics.rejected("draining").inc()
             raise ShedError("draining", retryable=True,
                             retry_after_s=self.retry_after_s,
                             detail=self._closed_reason)
         if len(self.queue) >= self.max_queue_depth:
             self.counters["rejected"] += 1
+            if self.metrics is not None:
+                self.metrics.rejected("queue_full").inc()
             raise ShedError("queue_full", retryable=True,
                             retry_after_s=self.retry_after_s,
                             detail=f"depth {len(self.queue)} >= "
@@ -102,8 +111,12 @@ class RequestManager:
     def admit(self, req: ServeRequest) -> None:
         self.queue.remove(req)
         req.state = PREFILLING
+        req.admitted_at = self.clock()
         self.active[req.uid] = req
         self.counters["admitted"] += 1
+        if self.metrics is not None and self.metrics.spans_enabled:
+            self.metrics.queue_wait_ms.observe(
+                (req.admitted_at - req.submitted_at) * 1e3)
 
     def _finish(self, req: ServeRequest, state: str) -> None:
         if req.uid in self.active:
@@ -123,6 +136,11 @@ class RequestManager:
         req.finish_reason = finish_reason
         self._finish(req, COMPLETED)
         self.counters["completed"] += 1
+        if self.metrics is not None:
+            self.metrics.terminal(COMPLETED).inc()
+            if self.metrics.spans_enabled:
+                self.metrics.e2e_ms.observe(
+                    (req.finished_at - req.submitted_at) * 1e3)
 
     def shed(self, req: ServeRequest, reason: str, retryable: bool = True
              ) -> None:
@@ -132,6 +150,9 @@ class RequestManager:
         self._finish(req, SHED)
         self.counters["shed"] += 1
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        if self.metrics is not None:
+            self.metrics.terminal(SHED).inc()
+            self.metrics.shed(reason).inc()
         logger.warning(f"serving: shed uid={req.uid} ({reason}, "
                        f"prefilled={req.prefilled}/{req.prompt_len}, "
                        f"generated={len(req.generated)})")
@@ -146,6 +167,8 @@ class RequestManager:
         req.finish_reason = reason
         self._finish(req, CANCELLED)
         self.counters["cancelled"] += 1
+        if self.metrics is not None:
+            self.metrics.terminal(CANCELLED).inc()
         return True
 
     def expire(self, now: Optional[float] = None) -> List[ServeRequest]:
@@ -161,6 +184,8 @@ class RequestManager:
             req.finish_reason = "deadline"
             self._finish(req, EXPIRED)
             self.counters["expired"] += 1
+            if self.metrics is not None:
+                self.metrics.terminal(EXPIRED).inc()
             logger.warning(f"serving: deadline expired uid={req.uid} "
                            f"(prefilled={req.prefilled}/{req.prompt_len}, "
                            f"generated={len(req.generated)})")
@@ -183,6 +208,12 @@ class RequestManager:
     def result(self, uid: int) -> Optional[ServeRequest]:
         return self.done.get(uid) or self.active.get(uid) or next(
             (r for r in self.queue if r.uid == uid), None)
+
+    def trace(self, uid: int) -> Optional[Dict]:
+        """The request's span record (queue-wait/TTFT/TPOT/e2e ms), or None
+        for an unknown uid — see :meth:`ServeRequest.span`."""
+        req = self.result(uid)
+        return None if req is None else req.span()
 
     @property
     def queue_depth(self) -> int:

@@ -81,6 +81,10 @@ class ServeRequest:
     prefilled: int = 0                 # prompt tokens already in KV
     generated: List[int] = dataclasses.field(default_factory=list)
     next_token: Optional[int] = None   # token to feed on the next decode step
+    # span timestamps (batcher clock domain) — the request IS its trace
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
     # terminal bookkeeping
     finish_reason: str = ""            # length | eos | shed slug | expired
     error: Optional[ShedError] = None
@@ -108,6 +112,26 @@ class ServeRequest:
         (LIFO within a priority class — the request that waited longest keeps
         its place)."""
         return (self.priority, -self.submitted_at)
+
+    def span(self) -> dict:
+        """The request's trace: admit → queue-wait → TTFT → per-token decode
+        → terminal, in milliseconds of the batcher's clock domain. Fields
+        are None until the request reaches that point of its lifecycle."""
+        def ms(a, b):
+            return None if a is None or b is None else round((b - a) * 1e3, 3)
+        n_decode_gaps = max(0, len(self.generated) - 1)
+        decode_ms = ms(self.first_token_at, self.last_token_at)
+        return {
+            "uid": self.uid, "state": self.state,
+            "finish_reason": self.finish_reason or None,
+            "prompt_tokens": self.prompt_len,
+            "generated_tokens": len(self.generated),
+            "queue_wait_ms": ms(self.submitted_at, self.admitted_at),
+            "ttft_ms": ms(self.submitted_at, self.first_token_at),
+            "tpot_ms": (None if not n_decode_gaps or decode_ms is None
+                        else round(decode_ms / n_decode_gaps, 3)),
+            "e2e_ms": ms(self.submitted_at, self.finished_at),
+        }
 
 
 def as_prompt(tokens: Sequence[int]) -> np.ndarray:

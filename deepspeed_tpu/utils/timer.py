@@ -181,6 +181,8 @@ class ThroughputTimer:
                 if self.flops_per_sample:
                     tflops = samples_per_sec * self.flops_per_sample / 1e12
                     msg += f" est_tflops={tflops:.1f}"
+                if self.monitor_memory:
+                    msg += " | " + SynchronizedWallClockTimer.memory_usage()
                 log_dist(msg)
                 self.step_elapsed_time = 0.0
                 self.steps_in_window = 0

@@ -1,0 +1,117 @@
+"""Monitor-layer unit tests (``deepspeed_tpu/monitor``).
+
+Satellite coverage the layer never had: the ``CSVMonitor`` round-trip
+(header once, appends accumulate, tag sanitization, the handle cache
+actually caching), ``MonitorMaster`` graceful degradation when a backend's
+client library fails to import, and the full registry → bridge → CSV
+pipeline the observability layer rides on.
+"""
+
+import csv
+import os
+
+import pytest
+
+from deepspeed_tpu.config.config import MonitorBackendConfig, MonitorConfig
+from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+from deepspeed_tpu.observability import MetricsRegistry, MonitorBridge
+
+pytestmark = pytest.mark.obs
+
+
+def _csv_cfg(tmp_path, job="job"):
+    return MonitorBackendConfig(enabled=True, output_path=str(tmp_path),
+                                job_name=job)
+
+
+def _rows(path):
+    with open(path, newline="") as f:
+        return list(csv.reader(f))
+
+
+class TestCSVMonitor:
+    def test_round_trip_header_append_and_sanitization(self, tmp_path):
+        mon = CSVMonitor(_csv_cfg(tmp_path))
+        mon.write_events([("a/b c", 1.0, 1), ("a/b c", 2.5, 2),
+                          ("plain", 7.0, 1)])
+        mon.write_events([("a/b c", 4.0, 3)])
+        rows = _rows(tmp_path / "job" / "a_b_c.csv")
+        assert rows[0] == ["step", "value", "time"]        # header once
+        assert [(r[0], r[1]) for r in rows[1:]] == [
+            ("1", "1.0"), ("2", "2.5"), ("3", "4.0")]
+        assert (tmp_path / "job" / "plain.csv").exists()
+        mon.close()
+
+    def test_handle_cache_reuses_one_open_file_per_tag(self, tmp_path):
+        mon = CSVMonitor(_csv_cfg(tmp_path))
+        mon.write_events([("t", 1.0, 1)])
+        f1 = mon._files["t"][0]
+        for step in range(2, 6):
+            mon.write_events([("t", float(step), step)])
+        assert mon._files["t"][0] is f1          # cached, not reopened
+        assert len(mon._files) == 1
+        # rows are visible to an independent reader without close() —
+        # write_events flushes the touched handles
+        assert len(_rows(tmp_path / "job" / "t.csv")) == 6
+        mon.close()
+        assert mon._files == {} and f1.closed
+        # writing after close() reopens and appends (no second header)
+        mon.write_events([("t", 9.0, 9)])
+        rows = _rows(tmp_path / "job" / "t.csv")
+        assert rows[-1][0] == "9"
+        assert sum(1 for r in rows if r[0] == "step") == 1  # header once
+        mon.close()
+
+    def test_close_is_idempotent(self, tmp_path):
+        mon = CSVMonitor(_csv_cfg(tmp_path))
+        mon.write_events([("t", 1.0, 1)])
+        mon.close()
+        mon.close()
+
+
+class TestMonitorMaster:
+    def test_degrades_gracefully_when_backend_import_fails(self, tmp_path,
+                                                           monkeypatch):
+        import deepspeed_tpu.monitor.monitor as mm
+
+        def _boom(self, cfg):
+            raise ImportError("no tensorboard in this environment")
+
+        monkeypatch.setattr(mm.TensorBoardMonitor, "__init__", _boom)
+        cfg = MonitorConfig(
+            tensorboard={"enabled": True, "output_path": str(tmp_path)},
+            csv_monitor={"enabled": True, "output_path": str(tmp_path),
+                         "job_name": "deg"})
+        master = MonitorMaster(cfg)              # must not raise
+        assert len(master.backends) == 1
+        assert isinstance(master.backends[0], CSVMonitor)
+        master.write_events([("x", 1.0, 1)])     # surviving backend works
+        assert (tmp_path / "deg" / "x.csv").exists()
+        master.close()
+
+    def test_registry_bridge_csv_end_to_end(self, tmp_path):
+        """The observability pipeline: instruments → MonitorBridge deltas →
+        MonitorMaster → CSV files on disk."""
+        reg = MetricsRegistry()
+        master = MonitorMaster(MonitorConfig(csv_monitor={
+            "enabled": True, "output_path": str(tmp_path),
+            "job_name": "e2e"}))
+        bridge = MonitorBridge(master, reg)
+        reg.counter("serving/requests",
+                    labels={"terminal": "completed"}).inc(3)
+        h = reg.histogram("serving/ttft_ms")
+        for v in (5.0, 9.0, 40.0):
+            h.observe(v)
+        reg.gauge("serving/kv_occupancy").set(0.25)
+        bridge.flush(step=10)
+        out = tmp_path / "e2e"
+        assert _rows(out / "serving_requests.terminal=completed.csv")[-1][:2] \
+            == ["10", "3.0"]
+        assert _rows(out / "serving_kv_occupancy.csv")[-1][:2] == ["10", "0.25"]
+        ttft_count = _rows(out / "serving_ttft_ms_count.csv")
+        assert ttft_count[-1][:2] == ["10", "3.0"]
+        assert (out / "serving_ttft_ms_p99.csv").exists()
+        # delta semantics: an unchanged registry adds no rows
+        bridge.flush(step=11)
+        assert len(_rows(out / "serving_kv_occupancy.csv")) == 2
+        master.close()

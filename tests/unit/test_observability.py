@@ -1,0 +1,425 @@
+"""Unified observability layer tests (``deepspeed_tpu/observability``).
+
+Pins the acceptance contracts: the Prometheus text exposition parses under
+the text-format grammar (a small grammar validator lives in this file),
+``/healthz`` / ``/readyz`` flip with the batcher health states, the
+``serving/ttft_ms`` + ``serving/tpot_ms`` histograms populate in a real
+``ContinuousBatcher`` run with tracing enabled, the profile trigger's
+arm/warmup/rate-limit lifecycle, and the registry→bridge delta semantics.
+The end-to-end load/overhead/profile drills live in ``tools/obs_drill.py``;
+a slow-marked wrapper runs them at the bottom.
+"""
+
+import json
+import math
+import os
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.observability import (MetricsRegistry, MonitorBridge,
+                                         ObservabilityServer, ProfileTrigger,
+                                         exponential_bounds, probe_status)
+
+pytestmark = pytest.mark.obs
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+
+
+# ---------------------------------------------------------------------------
+# a small Prometheus text-format (0.0.4) grammar validator
+# ---------------------------------------------------------------------------
+
+_METRIC = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf'^({_METRIC})'                                   # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'     # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*")*\})?'  # more labels
+    r' (-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$')               # value
+_HELP_RE = re.compile(rf"^# HELP ({_METRIC}) .*$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_METRIC}) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def validate_prometheus(text: str) -> dict:
+    """Parse/validate exposition text; returns {metric: [(labels, value)]}.
+    Raises AssertionError with the offending line on any grammar break, and
+    checks the histogram invariants (monotone buckets, +Inf == _count)."""
+    samples: dict = {}
+    typed: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line) or _TYPE_RE.match(line)
+            assert m, f"bad comment line: {line!r}"
+            if line.startswith("# TYPE"):
+                tm = _TYPE_RE.match(line)
+                typed[tm.group(1)] = tm.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"bad sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", m.group(4)
+        samples.setdefault(name, []).append((labels, value))
+    # histogram invariants per histogram family
+    for fam, kind in typed.items():
+        if kind != "histogram":
+            continue
+        buckets = samples.get(f"{fam}_bucket", [])
+        counts = samples.get(f"{fam}_count", [])
+        assert buckets and counts, f"histogram {fam} missing series"
+        assert f"{fam}_sum" in samples
+        by_series: dict = {}
+        for labels, value in buckets:
+            le = re.search(r'le="([^"]*)"', labels).group(1)
+            rest = re.sub(r'le="[^"]*",?', "", labels).strip("{},")
+            by_series.setdefault(rest, []).append((le, float(value)))
+        for rest, bs in by_series.items():
+            vals = [v for _le, v in bs]
+            assert vals == sorted(vals), f"{fam} buckets not monotone"
+            les = [le for le, _v in bs]
+            assert les[-1] == "+Inf", f"{fam} missing +Inf bucket"
+            total = [float(v) for labels, v in counts
+                     if labels.strip("{}") == rest]
+            assert total and total[0] == vals[-1], \
+                f"{fam} +Inf bucket != _count"
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_monotonic_and_labeled_series(self):
+        r = MetricsRegistry()
+        c = r.counter("x/reqs", "requests", labels={"kind": "a"})
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        c2 = r.counter("x/reqs", labels={"kind": "b"})
+        assert c2.value == 0.0                # distinct series
+        assert r.counter("x/reqs", labels={"kind": "a"}) is c  # get-or-create
+
+    def test_type_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x/n")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("x/n")
+
+    def test_histogram_percentiles_bounded_by_min_max(self):
+        r = MetricsRegistry()
+        h = r.histogram("x/lat_ms", bounds=exponential_bounds(1.0, 2.0, 10))
+        rng = np.random.default_rng(0)
+        xs = rng.lognormal(2.0, 0.8, 2000)
+        for x in xs:
+            h.observe(float(x))
+        assert h.count == 2000
+        assert math.isclose(h.sum, float(np.sum(xs)), rel_tol=1e-9)
+        p50, p95, p99 = (h.percentile(q) for q in (50, 95, 99))
+        assert xs.min() <= p50 <= p95 <= p99 <= xs.max()
+        # estimates land near the truth (log-linear interpolation within a
+        # factor-2 bucket is at worst ~sqrt(2) off; lognormal is smooth)
+        assert abs(p50 - float(np.percentile(xs, 50))) \
+            <= 0.5 * float(np.percentile(xs, 50))
+
+    def test_empty_histogram_is_zero(self):
+        r = MetricsRegistry()
+        h = r.histogram("x/empty")
+        assert h.percentile(99) == 0.0 and h.count == 0
+
+    def test_histogram_window_sees_a_fresh_regression(self):
+        """Lifetime percentiles bury a new regression under old samples;
+        the rolled window must report the recent distribution instead."""
+        from deepspeed_tpu.observability import HistogramWindow
+
+        r = MetricsRegistry()
+        h = r.histogram("x/lat_ms")
+        for _ in range(10_000):               # long healthy history
+            h.observe(2.0)
+        w = HistogramWindow(h)
+        w.roll()
+        w.roll()                              # window base = now
+        for _ in range(100):                  # sustained 10x regression
+            h.observe(20.0)
+        assert h.percentile(50) < 4.0         # lifetime: still "healthy"
+        assert w.percentile(50) > 10.0        # window: regression visible
+        assert w.count == 100
+        # a window created mid-history never sees earlier samples
+        w2 = HistogramWindow(h)
+        assert w2.count == 0 and w2.percentile(99) == 0.0
+
+    def test_render_prometheus_parses_and_sanitizes_names(self):
+        r = MetricsRegistry()
+        r.counter("serving/shed_total", "s", labels={"reason": "kv"}).inc(2)
+        r.gauge("serving/kv_occupancy").set(0.5)
+        h = r.histogram("serving/ttft_ms", "ttft")
+        for v in (1.0, 3.0, 1000.0, 1e9):     # incl. +Inf overflow bucket
+            h.observe(v)
+        samples = validate_prometheus(r.render_prometheus())
+        assert samples["serving_shed_total_total"] == [('{reason="kv"}', "2")]
+        assert "serving_ttft_ms_bucket" in samples
+        snap = r.snapshot()
+        json.dumps(snap)                      # JSON-serializable
+        assert snap["serving/ttft_ms"]["series"][0]["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# exposition + probes
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=5)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestExposition:
+    def test_probe_mapping(self):
+        assert probe_status("ready") == {"health": "ready", "live": True,
+                                         "ready": True}
+        assert probe_status("degraded")["ready"] is True
+        assert probe_status("starting")["ready"] is False
+        d = probe_status("draining")
+        assert d["live"] and not d["ready"]   # finish in-flight, route away
+        assert probe_status(None)["ready"] is True
+
+    def test_http_endpoints_flip_with_health(self):
+        r = MetricsRegistry()
+        r.gauge("x/g").set(1.0)
+        state = ["starting"]
+        with ObservabilityServer(r, health_fn=lambda: state[0]) as srv:
+            assert _get(srv.url + "/healthz")[0] == 200
+            assert _get(srv.url + "/readyz")[0] == 503
+            state[0] = "ready"
+            assert _get(srv.url + "/readyz")[0] == 200
+            state[0] = "degraded"
+            assert _get(srv.url + "/readyz")[0] == 200
+            state[0] = "draining"
+            assert _get(srv.url + "/readyz")[0] == 503
+            assert _get(srv.url + "/healthz")[0] == 200
+            code, body = _get(srv.url + "/metrics")
+            assert code == 200
+            validate_prometheus(body)
+            code, body = _get(srv.url + "/metrics.json")
+            assert code == 200 and json.loads(body)["x/g"]
+            assert _get(srv.url + "/nope")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# profile trigger lifecycle (stubbed capture fns; the real-jax.profiler
+# path is exercised by tools/obs_drill.py profile-capture)
+# ---------------------------------------------------------------------------
+
+class TestProfileTrigger:
+    def _trigger(self, tmp_path, **kw):
+        events = []
+        t = ProfileTrigger(
+            str(tmp_path), start_fn=lambda d: events.append(("start", d)),
+            stop_fn=lambda: events.append(("stop",)), **kw)
+        return t, events
+
+    def test_capture_spans_n_steps_and_is_rate_limited(self, tmp_path):
+        now = [0.0]
+        t, events = self._trigger(tmp_path, capture_steps=3, warmup_steps=0,
+                                  rate_limit_s=100.0, clock=lambda: now[0])
+        t.arm()
+        assert t.check(1) is None and t.capturing
+        t.check(2)
+        t.check(3)
+        cap = t.check(4)                      # step >= 1+3 → stop
+        assert cap and cap.startswith(str(tmp_path))
+        assert [e[0] for e in events] == ["start", "stop"]
+        assert t.counters["captures"] == 1
+        t.arm()                               # inside the rate-limit window
+        t.check(5)
+        assert not t.capturing
+        assert t.counters["suppressed_rate_limit"] == 1
+        now[0] = 200.0                        # window passed
+        t.arm()
+        t.check(6)
+        assert t.capturing
+
+    def test_warmup_holds_the_arm_instead_of_dropping_it(self, tmp_path):
+        t, events = self._trigger(tmp_path, capture_steps=1, warmup_steps=3,
+                                  rate_limit_s=0.0)
+        t.arm()
+        for s in (1, 2, 3):                   # compile territory: held
+            t.check(s)
+            assert not t.capturing
+        t.check(4)
+        assert t.capturing                    # fired on the first safe step
+
+    def test_trigger_file_is_consumed(self, tmp_path):
+        t, events = self._trigger(tmp_path, capture_steps=1, warmup_steps=0,
+                                  rate_limit_s=0.0)
+        open(t.trigger_file, "w").close()
+        t.check(1)
+        assert t.capturing
+        assert not os.path.exists(t.trigger_file)
+
+    def test_start_failure_is_contained(self, tmp_path):
+        t = ProfileTrigger(str(tmp_path), warmup_steps=0,
+                           start_fn=lambda d: 1 / 0,
+                           stop_fn=lambda: None)
+        t.arm()
+        assert t.check(1) is None             # no raise into the step loop
+        assert not t.capturing
+        assert t.counters["capture_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# bridge delta semantics
+# ---------------------------------------------------------------------------
+
+class _SinkMonitor:
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend(events)
+
+
+def test_bridge_flushes_only_changed_series():
+    r = MetricsRegistry()
+    sink = _SinkMonitor()
+    bridge = MonitorBridge(sink, r, prefix="s/")
+    c = r.counter("s/n")
+    r.counter("other/ignored").inc()          # outside the prefix
+    h = r.histogram("s/lat_ms")
+    c.inc(2)
+    h.observe(4.0)
+    n = bridge.flush(step=1)
+    tags = {t for t, _v, _s in sink.events}
+    assert ("s/n", 2.0, 1) in sink.events
+    assert {"s/lat_ms_count", "s/lat_ms_p50", "s/lat_ms_p95",
+            "s/lat_ms_p99"} <= tags
+    assert not any(t.startswith("other/") for t in tags)
+    assert bridge.flush(step=2) == 0          # nothing changed → no events
+    c.inc()
+    assert bridge.flush(step=3) == 1          # only the changed counter
+    assert n >= 5
+
+
+def test_comms_logger_exports_per_op_totals():
+    from deepspeed_tpu.comm.logger import CommsLogger
+
+    r = MetricsRegistry()
+    cl = CommsLogger(enabled=True)
+    cl.append("all_reduce", 1024, 0.001)
+    cl.append("all_reduce", 1024)
+    cl.append("all_gather_into_tensor", 512, 0.002)
+    cl.export_to_registry(r)
+    cl.export_to_registry(r)                  # idempotent: deltas, not totals
+    assert r.counter("comm/all_reduce_calls").value == 2
+    assert r.counter("comm/all_reduce_bytes").value == 2048
+    assert r.counter("comm/all_gather_into_tensor_bytes").value == 512
+    assert cl.total_latency_s() == pytest.approx(0.003)
+    cl.append("all_reduce", 8)
+    cl.export_to_registry(r)
+    assert r.counter("comm/all_reduce_calls").value == 3
+
+
+# ---------------------------------------------------------------------------
+# serving integration: spans → SLO histograms → /metrics (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    return InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                             max_sequences=8, max_seq_len=128, block_size=16)
+
+
+def test_batcher_populates_slo_histograms_and_probes(tiny_engine):
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    r = MetricsRegistry()
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=4,
+                        trace_requests=True)
+    b = ContinuousBatcher(tiny_engine, cfg, registry=r)
+    uids = [b.submit(np.arange(20) % 250) for _ in range(3)]
+    b.pump(max_steps=50)
+    assert all(b.manager.resolve(u) == "completed" for u in uids)
+    # acceptance: ttft + tpot + queue-wait histograms populate
+    ttft = r.get("serving/ttft_ms").series[()]
+    tpot = r.get("serving/tpot_ms").series[()]
+    qw = r.get("serving/queue_wait_ms").series[()]
+    assert ttft.count == 3                    # one first token per request
+    assert tpot.count == 3 * (4 - 1)          # 3 decode gaps per request
+    assert qw.count == 3
+    assert r.counter("serving/requests",
+                     labels={"terminal": "completed"}).value == 3
+    # per-request span: the trace survives in the terminal ledger
+    span = b.request_trace(uids[0])
+    assert span["ttft_ms"] is not None and span["tpot_ms"] is not None
+    assert span["generated_tokens"] == 4
+    assert span["queue_wait_ms"] >= 0.0
+    # slo section of the report mirrors the same histograms
+    rep = b.serving_report()
+    assert rep["slo_ms"]["ttft"]["samples"] == 3
+    assert rep["latency_ms"]["samples"] == b.counters["engine_steps"]
+    # /metrics + probes over real HTTP, mapped from batcher health
+    with b.serve_metrics_http() as srv:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        samples = validate_prometheus(body)
+        assert "serving_ttft_ms_bucket" in samples
+        assert _get(srv.url + "/readyz")[0] == 200     # READY after steps
+        b.begin_drain("test")
+        assert _get(srv.url + "/readyz")[0] == 503     # DRAINING → not ready
+        assert _get(srv.url + "/healthz")[0] == 200
+
+    b.drain(timeout_s=5.0)
+
+
+def test_tracing_disabled_gates_spans_only_not_lifecycle_counters(
+        tiny_engine):
+    """trace_requests=False must disable ONLY the span histograms — the
+    terminal/shed/reject counters are one bump per transition and have to
+    keep recording or an overload incident goes invisible on /metrics."""
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    r = MetricsRegistry()
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=2,
+                        trace_requests=False)
+    b = ContinuousBatcher(tiny_engine, cfg, registry=r)
+    uid = b.submit(np.arange(10) % 250)
+    b.pump(max_steps=20)
+    assert b.manager.resolve(uid) == "completed"
+    for span_hist in ("serving/ttft_ms", "serving/queue_wait_ms",
+                      "serving/e2e_ms"):
+        assert r.get(span_hist).series[()].count == 0, span_hist
+    assert r.get("serving/step_ms").series[()].count > 0  # step timing stays
+    assert r.counter("serving/requests",
+                     labels={"terminal": "completed"}).value == 1
+
+
+# ---------------------------------------------------------------------------
+# drill wrappers (slow; the CLI is the invariant authority)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["metrics-under-load",
+                                      "profile-capture",
+                                      "overhead-budget"])
+def test_obs_drill_scenario(scenario, tmp_path):
+    import sys
+
+    sys.path.insert(0, _TOOLS)
+    from obs_drill import run_scenario
+
+    verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict

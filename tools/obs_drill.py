@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Observability drill CLI: prove the metrics/tracing/profiling substrate
+works against a real serving load, and that it is cheap enough to leave on
+— exit nonzero if any invariant fails (the observability face of
+``tools/chaos_drill.py`` / ``tools/serve_drill.py``).
+
+Scenarios:
+
+* **metrics-under-load** — synthetic continuous-batching load with tracing
+  enabled; scrape ``/metrics`` over real HTTP and assert the exposition
+  parses, the ``serving/ttft_ms`` / ``serving/tpot_ms`` /
+  ``serving/queue_wait_ms`` histograms populate, and ``/healthz`` /
+  ``/readyz`` flip with the batcher health states (DRAINING = live but
+  not ready).
+* **profile-capture** — arm the on-demand ``jax.profiler`` trigger via its
+  trigger file mid-load; assert exactly one rate-limited capture fires and
+  leaves trace artifacts on disk.
+* **overhead-budget** — alternate measurement windows of the same workload
+  with instrumentation enabled vs stubbed out; assert the median per-step
+  overhead stays under 2 % (or under an absolute 50 µs floor — below
+  timer noise there is nothing left to shave).
+
+    python tools/obs_drill.py --list
+    python tools/obs_drill.py --scenario metrics-under-load
+    python tools/obs_drill.py --all
+
+Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
+Slow pytest wrappers live in ``tests/unit/test_observability.py`` under
+the ``obs`` + ``slow`` markers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_engine():
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    return InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                             max_sequences=8, max_seq_len=128, block_size=16)
+
+
+def _make_batcher(engine, registry, **serving):
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    cfg = ServingConfig(**{"prefill_chunk": 32, "default_max_new_tokens": 8,
+                           **serving})
+    return ContinuousBatcher(engine, cfg, registry=registry)
+
+
+def _load(batcher, n=6, prompt_len=24, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    uids = [batcher.submit(rng.integers(0, 250, prompt_len))
+            for _ in range(n)]
+    batcher.pump(max_steps=500)
+    return uids
+
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=5)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# scenarios: each returns (ok: bool, details: dict)
+# ---------------------------------------------------------------------------
+
+def scenario_metrics_under_load(workdir):
+    """Tracing-enabled load; scrape /metrics over HTTP; assert the SLO
+    histograms populate, the text format carries well-formed histogram
+    series, and the probes follow READY -> DRAINING."""
+    from deepspeed_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    b = _make_batcher(_make_engine(), reg)
+    uids = _load(b)
+    resolved = {u: b.manager.resolve(u) for u in uids}
+    srv = b.serve_metrics_http()
+    try:
+        ready0 = _get(srv.url + "/readyz")[0]
+        live0 = _get(srv.url + "/healthz")[0]
+        code, text = _get(srv.url + "/metrics")
+        b.begin_drain("drill")
+        ready_drain = _get(srv.url + "/readyz")[0]
+        live_drain = _get(srv.url + "/healthz")[0]
+    finally:
+        srv.close()
+    b.drain(timeout_s=30.0)
+
+    ttft = reg.get("serving/ttft_ms").series[()]
+    tpot = reg.get("serving/tpot_ms").series[()]
+    qw = reg.get("serving/queue_wait_ms").series[()]
+
+    def bucket_counts(name):
+        vals = []
+        for line in text.splitlines():
+            if line.startswith(name + "_bucket"):
+                vals.append(float(line.rsplit(" ", 1)[1]))
+        return vals
+
+    ttft_buckets = bucket_counts("serving_ttft_ms")
+    details = {
+        "resolved": resolved,
+        "ttft_samples": ttft.count, "tpot_samples": tpot.count,
+        "queue_wait_samples": qw.count,
+        "ttft_p50_ms": round(ttft.percentile(50), 3),
+        "ttft_p99_ms": round(ttft.percentile(99), 3),
+        "scrape_code": code,
+        "ttft_bucket_series": ttft_buckets,
+        "probes": {"ready": ready0, "live": live0,
+                   "ready_draining": ready_drain,
+                   "live_draining": live_drain},
+        "report_slo": b.serving_report()["slo_ms"],
+    }
+    ok = (all(s == "completed" for s in resolved.values())
+          and code == 200
+          and ttft.count == len(uids) and qw.count == len(uids)
+          and tpot.count == len(uids) * 7          # 8 new tokens -> 7 gaps
+          and ttft_buckets == sorted(ttft_buckets)  # monotone cumulative
+          and ttft_buckets and ttft_buckets[-1] == float(ttft.count)
+          and ready0 == 200 and live0 == 200
+          and ready_drain == 503 and live_drain == 200)
+    return ok, details
+
+
+def scenario_profile_capture(workdir):
+    """Touch the trigger file mid-load; assert exactly one capture fires
+    (rate limit suppresses the second arm) and real jax.profiler artifacts
+    land in the capture directory."""
+    from deepspeed_tpu.observability import MetricsRegistry, ProfileTrigger
+
+    prof_dir = os.path.join(workdir or ".", "obs_drill_profiles")
+    b = _make_batcher(_make_engine(), MetricsRegistry(),
+                      default_max_new_tokens=16)
+    trig = ProfileTrigger(prof_dir, capture_steps=3, rate_limit_s=3600.0,
+                          warmup_steps=2)
+    b.profile_trigger = trig
+    os.makedirs(prof_dir, exist_ok=True)
+    open(trig.trigger_file, "w").close()       # arm from "outside"
+    uids = _load(b, n=4)
+    open(trig.trigger_file, "w").close()       # second arm: rate-limited
+    _load(b, n=2, seed=1)
+    if trig.capturing:                         # load ended mid-capture
+        b.step()
+    trig.close()
+    artifacts = [os.path.join(r, f) for r, _d, fs in os.walk(prof_dir)
+                 for f in fs]
+    details = {"counters": trig.counters,
+               "artifacts": artifacts[:8],
+               "n_artifacts": len(artifacts),
+               "resolved": {u: b.manager.resolve(u) for u in uids}}
+    ok = (trig.counters["captures"] == 1
+          and trig.counters["suppressed_rate_limit"] == 1
+          and trig.counters["capture_errors"] == 0
+          and len(artifacts) > 0
+          and not os.path.exists(trig.trigger_file))
+    return ok, details
+
+
+class _NullMetrics:
+    """API-compatible no-op ServingMetrics: the zero-instrumentation
+    baseline the overhead budget is measured against."""
+
+    class _Noop:
+        def observe(self, v):
+            pass
+
+        def set(self, v):
+            pass
+
+        def inc(self, v=1.0):
+            pass
+
+        percentile = lambda self, q: 0.0  # noqa: E731
+        count = 0
+
+    def __init__(self):
+        n = self._Noop()
+        self.ttft_ms = self.tpot_ms = self.queue_wait_ms = n
+        self.step_ms = self.e2e_ms = n
+        self.health = self.queue_depth = n
+        self.active_requests = self.kv_occupancy = n
+        self.registry = None
+        self.spans_enabled = False
+
+    def terminal(self, s):
+        return self._Noop()
+
+    def shed(self, r):
+        return self._Noop()
+
+    def rejected(self, r):
+        return self._Noop()
+
+    def set_health(self, h):
+        pass
+
+
+def scenario_overhead_budget(workdir):
+    """Two-part budget proof that the registry + span tracing cost < 2% of
+    a serving step (or < 50 us — below host-timer resolution):
+
+    1. **direct op cost** — microbenchmark EXACTLY the instrument
+       operations one traced serving step performs (step-latency observe,
+       four gauge updates, per-request clock reads + TTFT/TPOT observes,
+       the profile-trigger nil check) and divide by the measured median
+       step time. This is deterministic: the ops are pure host float work,
+       so the number reproduces to the microsecond.
+    2. **end-to-end A/B with an A/A noise floor** — steady-state decode
+       steps in alternating 8-step blocks on the SAME in-flight batch,
+       flipping between full instrumentation and no-op stubs. Decode
+       steps get monotonically slower as KV grows, so the estimator is
+       the symmetric ABA triplet median (``t_mid - (t_prev+t_next)/2``:
+       linear drift cancels; block minima reject one-sided scheduler
+       spikes). An identically-shaped A/A run (stubs in BOTH arms)
+       calibrates the sandbox's noise floor; the A/B overhead must stay
+       under max(budget + floor, 0.5 ms) — the absolute allowance keeps a
+       loaded CI worker green while a real regression (an accidental
+       device sync is >= 1 ms/step) still trips it.
+    """
+    import numpy as np
+
+    from deepspeed_tpu.observability import MetricsRegistry, ServingMetrics
+
+    engine = _make_engine()
+    real_null = _NullMetrics()
+    BLOCK = 8
+
+    def loaded_batcher(seed):
+        # 4 requests in steady decode: 24-token prompt + up to 100 new
+        # tokens each → ~96 pure decode steps before any completes
+        b = _make_batcher(engine, MetricsRegistry(),
+                          default_max_new_tokens=100)
+        rng = np.random.default_rng(seed)
+        [b.submit(rng.integers(0, 250, 24)) for _ in range(4)]
+        while b.manager.prefilling():
+            b.step()
+        return b
+
+    def set_mode(b, instrumented, real_metrics):
+        b._trace = instrumented
+        b.metrics = real_metrics if instrumented else real_null
+        b.manager.metrics = b.metrics if instrumented else None
+
+    def run_rounds(ab: bool):
+        """3 rounds of 10 alternating blocks; returns (rounds, step_ms).
+        ``ab=False`` stubs BOTH arms (the A/A noise calibration)."""
+        rounds, samples = [], []
+        for round_ in range(3):
+            b = loaded_batcher(round_)
+            real_metrics = b.metrics if ab else real_null
+            for _ in range(3):                 # warm the decode path
+                b.step()
+            mode = bool(round_ % 2)            # alternate starting mode too
+            sequence = []
+            for _block in range(10):
+                set_mode(b, mode, real_metrics)
+                best = float("inf")
+                for _ in range(BLOCK):
+                    t0 = time.perf_counter()
+                    b.step()
+                    best = min(best, time.perf_counter() - t0)
+                sequence.append((mode, best * 1e3))
+                samples.append(best * 1e3)
+                mode = not mode
+            rounds.append(sequence)
+            set_mode(b, True, real_metrics)
+            b.begin_drain("overhead drill")    # reclaim the pool
+            b.drain(timeout_s=30.0)
+            if engine.state.sequences:         # invariant: no leak
+                raise AssertionError(
+                    f"leaked sequences {list(engine.state.sequences)}")
+        return rounds, statistics.median(samples)
+
+    def triplet_median(rounds):
+        diffs = []
+        for seq in rounds:
+            for (m0, t0), (m1, t1), (m2, t2) in zip(seq, seq[1:], seq[2:]):
+                if m0 == m2 != m1:
+                    d = t1 - (t0 + t2) / 2.0
+                    diffs.append(d if m1 else -d)
+        return statistics.median(diffs), diffs
+
+    # -- part 2: end-to-end A/B + A/A floor ----------------------------
+    aa_rounds, base_step_ms = run_rounds(ab=False)
+    noise_floor_ms, aa_diffs = triplet_median(aa_rounds)
+    noise_floor_ms = abs(noise_floor_ms)
+    ab_rounds, _ = run_rounds(ab=True)
+    overhead_ms, ab_diffs = triplet_median(ab_rounds)
+
+    # -- part 1: direct cost of one traced step's instrument ops -------
+    m = ServingMetrics(MetricsRegistry())
+    clock = time.monotonic
+    N = 20000
+    t0 = time.perf_counter()
+    for i in range(N):
+        t_step = clock()                       # the step() t0 read
+        m.step_ms.observe(4.0)
+        m.set_health("ready")
+        m.queue_depth.set(0.0)
+        m.active_requests.set(4.0)
+        m.kv_occupancy.set(0.5)
+        for _ in range(4):                     # 4 decoding requests
+            now = clock()
+            m.tpot_ms.observe(now - t_step + 4.0)
+        _ = None is not None                   # profile-trigger nil check
+    ops_ms = (time.perf_counter() - t0) / N * 1e3
+
+    budget_ms = 0.02 * base_step_ms
+    ok_ops = ops_ms <= max(budget_ms, 0.05)
+    # the op microbench enforces the 2% budget deterministically; the e2e
+    # bound is the tripwire for a BIG hidden regression (an accidental
+    # device sync costs >= 1 ms/step here), so it gets an absolute 0.5 ms
+    # allowance on top of the calibrated floor — a loaded CI worker's
+    # residual noise (~0.1-0.4 ms observed) stays under it, a real sync
+    # regression cannot
+    ok_e2e = overhead_ms <= max(budget_ms + noise_floor_ms, 0.5)
+    details = {"ms_per_step": round(base_step_ms, 4),
+               "budget_ms": round(budget_ms, 4),
+               "op_cost_ms_per_step": round(ops_ms, 5),
+               "op_cost_pct": round(ops_ms / base_step_ms * 100, 3),
+               "e2e_overhead_ms": round(overhead_ms, 4),
+               "e2e_noise_floor_ms": round(noise_floor_ms, 4),
+               "ok_ops": ok_ops, "ok_e2e": ok_e2e,
+               "aa_triplet_diffs_ms": [round(d, 4) for d in aa_diffs],
+               "ab_triplet_diffs_ms": [round(d, 4) for d in ab_diffs]}
+    return ok_ops and ok_e2e, details
+
+
+SCENARIOS = {
+    "metrics-under-load": scenario_metrics_under_load,
+    "profile-capture": scenario_profile_capture,
+    "overhead-budget": scenario_overhead_budget,
+}
+
+
+def run_scenario(name: str, workdir=None) -> dict:
+    """Run one drill; returns the verdict record (also usable from tests)."""
+    if name not in SCENARIOS:
+        raise SystemExit(f"unknown scenario {name!r} "
+                         f"(have: {sorted(SCENARIOS)})")
+    t0 = time.time()
+    ok, details = SCENARIOS[name](workdir or ".")
+    return {"scenario": name, "ok": ok,
+            "seconds": round(time.time() - t0, 2), "details": details}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", help="which drill to run")
+    ap.add_argument("--all", action="store_true", help="run every scenario")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--workdir", default=".", help="scratch directory")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, fn in SCENARIOS.items():
+            print(f"{name}: {fn.__doc__.splitlines()[0]}")
+        return 0
+    names = list(SCENARIOS) if args.all else (
+        [args.scenario] if args.scenario else None)
+    if not names:
+        ap.error("pass --scenario NAME, --all, or --list")
+    rc = 0
+    for name in names:
+        verdict = run_scenario(name, workdir=args.workdir)
+        print(json.dumps(verdict, indent=2, default=str))
+        if not verdict["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
