@@ -1,0 +1,200 @@
+"""User-style drive for PR 7: network serving front-end + replica router.
+
+Drives the PUBLIC surface: JSON config via ``deepspeed_tpu.from_config``
+(serving.frontend / serving.router blocks), ``ServingFrontend.
+from_deepspeed_config`` over a 2-replica ``ReplicaRouter``, real HTTP via
+``GenerateClient`` — then the failure probes (typo'd config keys, string
+prompt, oversize prompt, queue-full 429, disabled-block refusal) and the
+drain/migration path. CPU-only container (no /root/.axon_site): runs on
+the default single CPU device, which is what serving uses anyway.
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.serving import (ContinuousBatcher, FrontendError,  # noqa: E402
+                                   GenerateClient, Replica, ReplicaRouter,
+                                   ServingFrontend)
+
+CHECKS = []
+
+
+def check(name, ok, detail=""):
+    CHECKS.append((name, bool(ok), detail))
+    print(f"[{'ok' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail
+                                                  else ""))
+
+
+cfg_json = {
+    "train_batch_size": 8,
+    "serving": {
+        "enabled": True,
+        "prefill_chunk": 32,
+        "default_max_new_tokens": 4,
+        "max_queue_depth": 4,
+        "retry_after_s": 0.5,
+        "frontend": {
+            "enabled": True,
+            "api_keys": {"gold-tenant": 7},
+            "max_prompt_tokens": 64,
+        },
+        "router": {"enabled": True, "failover_attempts": 0},
+    },
+}
+path = os.path.join(tempfile.mkdtemp(), "ds.json")
+with open(path, "w") as f:
+    json.dump(cfg_json, f)
+cfg = deepspeed_tpu.from_config(path)
+check("from_config consumes serving.frontend/router blocks",
+      cfg.serving.frontend.api_keys == {"gold-tenant": 7}
+      and cfg.serving.router.enabled)
+
+# config probes: pydantic must name the bad field
+try:
+    deepspeed_tpu.DeepSpeedTpuConfig(train_batch_size=8, serving={
+        "enabled": True, "frontend": {"api_kyes": {"a": 1}}})
+    check("typo'd frontend key rejected", False)
+except Exception as e:
+    check("typo'd frontend key rejected", "api_kyes" in str(e), str(e)[:80])
+try:
+    deepspeed_tpu.DeepSpeedTpuConfig(train_batch_size=8, serving={
+        "enabled": True, "router": {"failover_attempts": -1}})
+    check("negative failover_attempts rejected", False)
+except Exception as e:
+    check("negative failover_attempts rejected",
+          "failover_attempts" in str(e))
+
+from deepspeed_tpu.models import TransformerLM, get_preset  # noqa: E402
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2  # noqa: E402
+
+
+def engine():
+    return InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                             max_sequences=8, max_seq_len=128,
+                             block_size=16)
+
+
+e0, e1 = engine(), engine()
+b0 = ContinuousBatcher.from_deepspeed_config(e0, cfg)
+b1 = ContinuousBatcher.from_deepspeed_config(e1, cfg)
+r0, r1 = Replica("r0", b0), Replica("r1", b1)
+router = ReplicaRouter([r0, r1], cfg.serving.router).start()
+
+# the disabled-block refusal
+try:
+    ServingFrontend.from_deepspeed_config(
+        router, deepspeed_tpu.DeepSpeedTpuConfig(train_batch_size=8))
+    check("frontend without serving.frontend.enabled refused", False)
+except ValueError as e:
+    check("frontend without serving.frontend.enabled refused",
+          "serving.frontend.enabled" in str(e))
+
+fe = ServingFrontend.from_deepspeed_config(router, cfg).start()
+cli = GenerateClient(fe.url, timeout_s=120)
+
+out = cli.generate(list(range(1, 17)), max_new_tokens=3)
+check("unary generate over HTTP", out["state"] == "completed"
+      and len(out["tokens"]) == 3 and out["span"]["ttft_ms"] is not None)
+
+evs = list(GenerateClient(fe.url, api_key="gold-tenant").stream(
+    list(range(1, 13)), max_new_tokens=3))
+check("SSE stream: tokens then end",
+      [e["event"] for e in evs] == ["token", "token", "token", "end"]
+      and evs[-1]["data"]["state"] == "completed")
+
+import http.client  # noqa: E402
+
+conn = http.client.HTTPConnection(fe.server.host, fe.server.port, timeout=10)
+conn.request("GET", "/metrics")
+resp = conn.getresponse()
+scrape = resp.read().decode()
+check("one port: /metrics next to the API", resp.status == 200
+      and "serving_queue_depth" in scrape
+      and "frontend_http_requests_total" in scrape)
+conn.request("GET", "/readyz")
+rz = conn.getresponse()
+rz.read()
+check("one port: /readyz ready", rz.status == 200)
+conn.close()
+
+# wire-protocol probes (raw POST — the client would refuse client-side)
+conn = http.client.HTTPConnection(fe.server.host, fe.server.port, timeout=10)
+conn.request("POST", "/v1/generate", body=json.dumps({"prompt": "a string"}),
+             headers={"Content-Type": "application/json",
+                      "Connection": "close"})
+raw = conn.getresponse()
+body = json.loads(raw.read().decode())
+check("string prompt -> 400", raw.status == 400
+      and body["error"]["type"] == "prompt_not_tokenized")
+conn.close()
+try:
+    cli.generate(list(range(100)))       # > max_prompt_tokens=64
+    check("oversize prompt -> 413", False)
+except FrontendError as e:
+    check("oversize prompt -> 413", e.status == 413)
+
+# queue-full 429 with the load-aware Retry-After
+r0.paused = r1.paused = True
+for _ in range(8):                       # fill both 4-deep queues
+    router.submit(list(range(8)), max_new_tokens=2)
+try:
+    cli.generate(list(range(8)), max_new_tokens=2)
+    check("queue-full -> 429 + Retry-After", False)
+except FrontendError as e:
+    check("queue-full -> 429 + Retry-After", e.status == 429
+          and e.retry_after_s is not None
+          and e.body["error"]["retry_after_s"] > 0.5,
+          f"retry_after={e.body['error']['retry_after_s']}")
+
+# SIGTERM drain of r0 -> queued requests migrate to r1 and complete.
+# Let r1 work off its queue first so the siblings have room to take them.
+r1.paused = False
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline and (
+        r1.stats["active"] or r1.stats["queue_depth"]):
+    time.sleep(0.05)
+r1.paused = True
+router.install_signal_handlers(drain="r0")
+queued_r0 = r0.stats["queue_depth"]
+os.kill(os.getpid(), signal.SIGTERM)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline and (
+        router.counters["migrated"] + router.counters["migration_failed"]
+        < queued_r0):
+    time.sleep(0.05)
+r0.paused = r1.paused = False
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline and any(
+        r.stats["active"] or r.stats["queue_depth"] for r in (r0, r1)):
+    time.sleep(0.1)
+states = [router.resolve(u) for u in range(router._next_ruid)]
+check("SIGTERM drain migrated the queue",
+      router.counters["migrated"] >= 1 and queued_r0 >= 1,
+      f"queued_r0={queued_r0} counters={router.counters}")
+check("every routed uid resolves terminal",
+      all(s in ("completed", "shed", "expired", "cancelled")
+          for s in states), f"{states}")
+check("draining replica not routable, pool still ready",
+      not r0.routable and router.health == "ready")
+
+fe.close()
+fe.close()
+router.close()
+router.close()
+for name, eng in (("r0", e0), ("r1", e1)):
+    alloc = eng.state.allocator
+    check(f"KV pool restored on {name}",
+          alloc.free_blocks == alloc.num_blocks
+          and not eng.state.sequences)
+
+failed = [c for c in CHECKS if not c[1]]
+print(f"\n{len(CHECKS) - len(failed)}/{len(CHECKS)} checks passed")
+sys.exit(1 if failed else 0)

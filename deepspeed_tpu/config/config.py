@@ -493,6 +493,81 @@ class HeartbeatConfig(DSTpuConfigModel):
     exit_code: int = 47
 
 
+class FrontendConfig(DSTpuConfigModel):
+    """``serving.frontend``: the stdlib-HTTP network front-end
+    (``deepspeed_tpu/serving/frontend.py``) — ``POST /v1/generate`` (JSON,
+    with an SSE/chunked streaming variant) mounted on the SAME mux as the
+    observability probes, so ``/metrics`` / ``/healthz`` / ``/readyz`` and
+    the API share one port. Backpressure contract: retryable
+    :class:`ShedError` → ``429`` + ``Retry-After``; terminal refusals
+    (``oversize``, over ``max_prompt_tokens``) → ``413``; deadline expiry
+    → ``504``."""
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0                     # 0 = ephemeral
+    # per-tenant priority: x-api-key header value → admission priority
+    # (the RequestManager's integer priorities; higher = shed later)
+    api_keys: Dict[str, int] = Field(default_factory=dict)
+    require_api_key: bool = False     # 401 requests without a known key
+    allow_priority_header: bool = True  # honor x-priority / body "priority"
+    # bounds on the x-priority/body override: self-promotion caps at
+    # max_header_priority (default 0 — only api_keys buy shed-later) and
+    # self-demotion at min_header_priority; the floor also keeps an
+    # anonymous client from minting unbounded per-priority metric labels
+    max_header_priority: int = 0
+    min_header_priority: int = -1
+    default_priority: int = 0
+    max_prompt_tokens: int = 8192     # 413 above this, before the queue
+    request_timeout_s: float = 120.0  # unary wait cap when no deadline given
+    max_body_bytes: int = 8 << 20
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.min_header_priority > self.max_header_priority:
+            raise ValueError("serving.frontend: min_header_priority must "
+                             "be <= max_header_priority")
+        if self.max_prompt_tokens < 1 or self.max_body_bytes < 1 \
+                or self.request_timeout_s <= 0:
+            raise ValueError("serving.frontend: max_prompt_tokens, "
+                             "max_body_bytes, request_timeout_s must be "
+                             "positive")
+        return self
+
+
+class RouterConfig(DSTpuConfigModel):
+    """``serving.router``: multi-replica load spreading above N
+    :class:`ContinuousBatcher` replicas
+    (``deepspeed_tpu/serving/router.py``) — least-loaded routing by
+    queue-depth/projected-KV, retryable-shed failover onto siblings before
+    surfacing 429, DRAINING replicas routed away via the readiness
+    semantics, and drain-time migration of queued-but-unstarted requests
+    onto siblings."""
+
+    enabled: bool = False
+    # max replicas tried per submit before surfacing the shed (0 = all)
+    failover_attempts: int = 0
+    migrate_on_drain: bool = True
+    idle_sleep_s: float = 0.002       # replica worker park time when idle
+    submit_timeout_s: float = 30.0    # cross-thread submit handshake cap
+    # terminal routing records kept for resolve(); oldest evicted past
+    # this so per-request router state stays bounded on a long-running
+    # front-end (live routes are bounded by queue+active caps anyway)
+    max_route_history: int = 65536
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.failover_attempts < 0:
+            raise ValueError("serving.router.failover_attempts must be >= 0")
+        if self.idle_sleep_s <= 0 or self.submit_timeout_s <= 0:
+            raise ValueError("serving.router: idle_sleep_s and "
+                             "submit_timeout_s must be > 0")
+        if self.max_route_history < 1:
+            raise ValueError("serving.router.max_route_history must be "
+                             ">= 1")
+        return self
+
+
 class ServingConfig(DSTpuConfigModel):
     """``serving`` section: the request-lifecycle layer above
     ``InferenceEngineV2`` (``deepspeed_tpu/serving``) — bounded admission,
@@ -530,6 +605,8 @@ class ServingConfig(DSTpuConfigModel):
     # reads per step; no device syncs). Gates ONLY the span histograms:
     # lifecycle counters (terminals/sheds/rejects) always record.
     trace_requests: bool = True
+    frontend: FrontendConfig = Field(default_factory=FrontendConfig)
+    router: RouterConfig = Field(default_factory=RouterConfig)
 
     @model_validator(mode="after")
     def _check(self):

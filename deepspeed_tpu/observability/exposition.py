@@ -1,9 +1,13 @@
-"""Stdlib HTTP exposition: ``/metrics``, ``/healthz``, ``/readyz``.
+"""Stdlib HTTP exposition: ``/metrics``, ``/healthz``, ``/readyz`` — and
+the mux the network-facing serving front-end mounts onto.
 
-This is the scrape surface the future network-facing serving front-end
-mounts directly; until that exists it runs as a sidecar thread next to a
-:class:`~deepspeed_tpu.serving.batcher.ContinuousBatcher` or a training
-engine. No third-party dependency — ``http.server`` on a daemon thread.
+Beyond the scrape endpoints, :meth:`ObservabilityServer.mount` registers
+extra ``(method, path)`` routes (the serving front-end adds
+``POST /v1/generate`` and ``GET /v1/state`` here), so the API and the
+probes share ONE port: an orchestrator scrapes ``/metrics`` and probes
+``/readyz`` on the same address it routes traffic to. No third-party
+dependency — ``http.server`` on a daemon thread, speaking HTTP/1.1 so a
+mounted route can stream a chunked response (SSE token events).
 
 Probe semantics (mapped from the batcher's health state machine):
 
@@ -57,14 +61,48 @@ def probe_status(health: Optional[str]) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dstpu-obs/1"
+    # HTTP/1.1 so mounted routes can stream chunked responses; every
+    # response therefore carries Content-Length or chunked framing
+    protocol_version = "HTTP/1.1"
 
-    def _send(self, code: int, body: str, ctype: str) -> None:
+    def _send(self, code: int, body: str, ctype: str,
+              headers: Optional[dict] = None) -> None:
         data = body.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
+
+    def _dispatch(self, method: str) -> bool:
+        """Run a mounted route if one matches; True if handled."""
+        path = self.path.split("?", 1)[0]
+        fn = getattr(self.server, "routes", {}).get((method, path))
+        if fn is None:
+            return False
+        try:
+            fn(self)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                       # client went away mid-response
+        except Exception as e:         # route bug ≠ serving-process death
+            logger.warning(f"observability: route {method} {path} "
+                           f"failed: {e}")
+            try:
+                self._send(500, json.dumps(
+                    {"error": {"type": "internal", "detail": str(e)}}),
+                    "application/json")
+            except OSError:
+                pass
+        return True
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        if not self._dispatch("POST"):
+            # the unread request body would desync a kept-alive HTTP/1.1
+            # connection (its bytes parse as the next request line)
+            self.close_connection = True
+            self._send(404, "not found\n", "text/plain")
 
     def do_GET(self):  # noqa: N802 (http.server API)
         srv = self.server
@@ -82,13 +120,38 @@ class _Handler(BaseHTTPRequestHandler):
                 ok = st["live"] if path == "/healthz" else st["ready"]
                 self._send(200 if ok else 503, json.dumps(st),
                            "application/json")
-            else:
+            elif not self._dispatch("GET"):
                 self._send(404, "not found\n", "text/plain")
         except Exception as e:  # never take the serving process down
             try:
                 self._send(500, f"scrape error: {e}\n", "text/plain")
             except OSError:
                 pass
+
+    # ------------------------------------------------------------------
+    # chunked streaming helpers for mounted routes (SSE token events)
+    # ------------------------------------------------------------------
+    def begin_chunked(self, code: int = 200,
+                      ctype: str = "text/event-stream",
+                      headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+
+    def write_chunk(self, data: bytes) -> None:
+        if not data:
+            return                     # a zero chunk would end the stream
+        self.wfile.write(f"{len(data):X}\r\n".encode("ascii") + data
+                         + b"\r\n")
+        self.wfile.flush()             # tokens must not sit in the buffer
+
+    def end_chunked(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
     def log_message(self, fmt, *args):  # silence per-request stderr noise
         pass
@@ -110,8 +173,19 @@ class ObservabilityServer:
         self._httpd.daemon_threads = True
         self._httpd.registry = self.registry
         self._httpd.health_fn = health_fn
+        self._routes: dict = {}
+        self._httpd.routes = self._routes
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    def mount(self, method: str, path: str, fn: Callable) -> None:
+        """Register an extra route on this mux. ``fn(handler)`` receives the
+        live ``BaseHTTPRequestHandler`` and owns the whole exchange (read
+        the body, send the response — ``handler._send`` for unary JSON,
+        ``begin_chunked``/``write_chunk``/``end_chunked`` for streams).
+        The built-in ``/metrics`` + probe paths cannot be shadowed."""
+        self._routes[(method.upper(), path)] = fn
 
     @classmethod
     def for_batcher(cls, batcher, registry=None, **kw) -> "ObservabilityServer":
@@ -123,7 +197,14 @@ class ObservabilityServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def start(self) -> "ObservabilityServer":
+        if self._closed:
+            raise RuntimeError("ObservabilityServer already closed; build "
+                               "a new one instead of rebinding")
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, name="dstpu-obs-http",
@@ -134,6 +215,11 @@ class ObservabilityServer:
         return self
 
     def close(self) -> None:
+        """Idempotent: stops the accept loop, joins the server thread, and
+        releases the listening socket; safe to call any number of times."""
+        if self._closed:
+            return
+        self._closed = True
         if self._thread is not None:
             self._httpd.shutdown()
             self._thread.join(timeout=5)

@@ -21,7 +21,8 @@ Metric schema (all under ``serving/``):
 * ``serving/rejected_total`` (counter, label ``reason=``) — admission
   refusals (queue_full / draining);
 * gauges: ``serving/health`` (0=starting 1=ready 2=degraded 3=draining),
-  ``serving/queue_depth``, ``serving/active_requests``,
+  ``serving/queue_depth`` (total, plus per-``{priority=}`` children — the
+  router's balancing signal), ``serving/active_requests``,
   ``serving/kv_occupancy``.
 """
 
@@ -78,6 +79,7 @@ class ServingMetrics:
         self._terminals: Dict[str, object] = {}
         self._sheds: Dict[str, object] = {}
         self._rejects: Dict[str, object] = {}
+        self._qdepth_prio: Dict[str, object] = {}
 
     # label-set children are created on first use and cached: terminal
     # states and shed reasons are small closed sets, so the dict stays tiny
@@ -107,3 +109,23 @@ class ServingMetrics:
 
     def set_health(self, health: str) -> None:
         self.health.set(float(HEALTH_CODES.get(health, -1)))
+
+    def set_queue_depths(self, by_priority: Dict[int, int]) -> None:
+        """Per-priority breakdown as ``serving/queue_depth{priority=}``
+        gauge children (the router's balancing signal). A priority class
+        that empties out is zeroed, not left at its last value — a scrape
+        must never show ghost backlog."""
+        seen = set()
+        for prio, depth in by_priority.items():
+            key = str(int(prio))
+            seen.add(key)
+            g = self._qdepth_prio.get(key)
+            if g is None:
+                g = self._qdepth_prio[key] = self.registry.gauge(
+                    "serving/queue_depth",
+                    "requests waiting for admission",
+                    labels={"priority": key})
+            g.set(float(depth))
+        for key, g in self._qdepth_prio.items():
+            if key not in seen:
+                g.set(0.0)

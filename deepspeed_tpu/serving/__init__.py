@@ -19,23 +19,43 @@ reference stack gets from FastGen/MII scheduling + backpressure:
   sliding failure window, SIGTERM graceful drain, ``serving/*`` monitor
   events, and ``serving_report()``.
 
+The network layer above the batcher (the MII/FastGen product-layer shape):
+
+* :mod:`~deepspeed_tpu.serving.protocol` — the wire contract: generate
+  request schema, tenant priority headers, ShedError → 429/``Retry-After``
+  mapping, SSE framing;
+* :mod:`~deepspeed_tpu.serving.router` — :class:`Replica` (one batcher +
+  its single worker thread publishing per-step token events) and
+  :class:`ReplicaRouter` (least-loaded routing, sibling failover on
+  retryable sheds, drain-aware rebalancing with queue migration);
+* :mod:`~deepspeed_tpu.serving.frontend` — :class:`ServingFrontend`:
+  ``POST /v1/generate`` (unary JSON + chunked SSE streaming) mounted on
+  the same mux as ``/metrics`` / ``/healthz`` / ``/readyz``;
+* :mod:`~deepspeed_tpu.serving.client` — :class:`GenerateClient`: stdlib
+  reference client honoring the 429/``Retry-After`` backpressure contract.
+
 Chaos-drilled by ``tools/serve_drill.py`` (deadline-storm,
-shed-under-KV-pressure, SIGTERM-drain) through the same deterministic
-fault injector that drills training (``resilience/faults.py`` serving
-sites: ``slow_decode``, ``decode_nan``, ``shed_storm``,
+shed-under-KV-pressure, SIGTERM-drain, frontend-storm) through the same
+deterministic fault injector that drills training (``resilience/faults.py``
+serving sites: ``slow_decode``, ``decode_nan``, ``shed_storm``,
 ``cache_io_error``).
 """
 
 from deepspeed_tpu.serving.batcher import (DEGRADED, DRAINING, READY,
                                            STARTING, ContinuousBatcher)
+from deepspeed_tpu.serving.client import FrontendError, GenerateClient
+from deepspeed_tpu.serving.frontend import ServingFrontend
 from deepspeed_tpu.serving.manager import RequestManager
 from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
                                            EXPIRED, PREFILLING, QUEUED, SHED,
                                            TERMINAL_STATES, ServeRequest,
                                            ShedError)
+from deepspeed_tpu.serving.router import Replica, ReplicaRouter
 
 __all__ = [
     "CANCELLED", "COMPLETED", "DECODING", "DEGRADED", "DRAINING", "EXPIRED",
     "PREFILLING", "QUEUED", "READY", "SHED", "STARTING", "TERMINAL_STATES",
-    "ContinuousBatcher", "RequestManager", "ServeRequest", "ShedError",
+    "ContinuousBatcher", "FrontendError", "GenerateClient", "Replica",
+    "ReplicaRouter", "RequestManager", "ServeRequest", "ServingFrontend",
+    "ShedError",
 ]

@@ -113,6 +113,7 @@ class ContinuousBatcher:
         # arm via trigger-file/SIGUSR2 for a live XLA capture (ProfileTrigger;
         # checked once per step when set — see tools/obs_drill.py)
         self.profile_trigger = None
+        self._http_server = None       # serve_metrics_http singleton
         # the bridge flushes the registry-native families; the four gauges
         # _serving_events already streams under the same tags are excluded
         # so one flush never writes a tag twice
@@ -471,6 +472,7 @@ class ContinuousBatcher:
         mx = self.metrics
         mx.set_health(self.health)
         mx.queue_depth.set(float(self.manager.queue_depth))
+        mx.set_queue_depths(self.manager.queue_depth_by_priority())
         mx.active_requests.set(float(len(self.manager.active)))
         mx.kv_occupancy.set(float(self.kv_occupancy))
 
@@ -481,13 +483,30 @@ class ContinuousBatcher:
         """Mount ``/metrics`` + ``/healthz`` / ``/readyz`` for this batcher
         (readiness follows READY/DEGRADED; a DRAINING replica reports
         not-ready but stays live). Returns the started
-        :class:`~deepspeed_tpu.observability.ObservabilityServer` — the
-        future HTTP front-end mounts the same handlers."""
+        :class:`~deepspeed_tpu.observability.ObservabilityServer`; the
+        serving front-end (:mod:`deepspeed_tpu.serving.frontend`) mounts
+        its API routes on the same mux. Idempotent: a second call returns
+        the already-running server instead of binding a second socket —
+        the first server must not leak unclosable behind the second. A
+        cached server closed externally is replaced, not returned dead."""
+        if self._http_server is not None and not self._http_server.closed:
+            return self._http_server
         from deepspeed_tpu.observability import ObservabilityServer
 
-        return ObservabilityServer.for_batcher(
+        self._http_server = ObservabilityServer.for_batcher(
             self, registry=self.metrics.registry, host=host,
             port=port).start()
+        return self._http_server
+
+    def close(self) -> None:
+        """Idempotent teardown of everything the batcher stood up outside
+        itself: the metrics HTTP server (joined, socket released) and the
+        SIGTERM handler. Does NOT drain — call :meth:`drain` first when
+        in-flight work matters."""
+        if self._http_server is not None:
+            self._http_server.close()
+            self._http_server = None
+        self.restore_signal_handlers()
 
     def request_trace(self, uid: int) -> Optional[Dict]:
         """Span record for any uid ever submitted (see ServeRequest.span)."""
@@ -515,6 +534,8 @@ class ContinuousBatcher:
             "counters": {**m.counters, **self.counters},
             "shed_reasons": dict(m.shed_reasons),
             "queue_depth": m.queue_depth,
+            "queue_depth_by_priority": m.queue_depth_by_priority(),
+            "retry_after_s": round(m.current_retry_after(), 3),
             "active_requests": len(m.active),
             "kv": {"num_blocks": self.num_blocks,
                    "used_blocks": self.used_blocks,

@@ -39,7 +39,12 @@ class RequestManager:
         self.max_queue_depth = int(max_queue_depth)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_deadline_s = default_deadline_s
+        # BASE back-off hint; what a ShedError actually carries is
+        # current_retry_after() — this base scaled by live pressure
         self.retry_after_s = float(retry_after_s)
+        # sliding window of recent outcomes (1.0 = shed/reject, 0.0 =
+        # accepted/completed) — the shed-rate half of the load-aware hint
+        self._pressure: Deque[float] = deque(maxlen=64)
         self.release_fn = release_fn
         self.clock = clock
         # optional ServingMetrics: terminal/shed/reject counters + the
@@ -69,19 +74,22 @@ class RequestManager:
         self.counters["submitted"] += 1
         if self._closed_reason is not None:
             self.counters["rejected"] += 1
+            self._pressure.append(1.0)
             if self.metrics is not None:
                 self.metrics.rejected("draining").inc()
             raise ShedError("draining", retryable=True,
-                            retry_after_s=self.retry_after_s,
+                            retry_after_s=self.current_retry_after(),
                             detail=self._closed_reason)
         if len(self.queue) >= self.max_queue_depth:
             self.counters["rejected"] += 1
+            self._pressure.append(1.0)
             if self.metrics is not None:
                 self.metrics.rejected("queue_full").inc()
             raise ShedError("queue_full", retryable=True,
-                            retry_after_s=self.retry_after_s,
+                            retry_after_s=self.current_retry_after(),
                             detail=f"depth {len(self.queue)} >= "
                                    f"{self.max_queue_depth}")
+        self._pressure.append(0.0)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = self.clock()
@@ -100,6 +108,18 @@ class RequestManager:
     def close(self, reason: str = "draining") -> None:
         """Stop admitting new requests (graceful-drain entry)."""
         self._closed_reason = reason
+
+    def current_retry_after(self) -> float:
+        """Load-aware back-off hint: the configured base scaled by queue
+        fullness and the recent shed/reject rate, so the ``Retry-After`` a
+        429 carries actually reflects pressure — an idle server says
+        "come back in ``retry_after_s``", a saturated one up to ~4x that.
+        Deterministic (count-based windows, no wall clock) so drills can
+        assert on it."""
+        qfrac = min(1.0, len(self.queue) / max(1, self.max_queue_depth))
+        p = self._pressure
+        sfrac = (sum(p) / len(p)) if p else 0.0
+        return self.retry_after_s * (1.0 + qfrac + 2.0 * sfrac)
 
     @property
     def closed(self) -> bool:
@@ -133,6 +153,7 @@ class RequestManager:
 
     def complete(self, req: ServeRequest, finish_reason: str = "length"
                  ) -> None:
+        self._pressure.append(0.0)      # healthy outcome decays the hint
         req.finish_reason = finish_reason
         self._finish(req, COMPLETED)
         self.counters["completed"] += 1
@@ -144,8 +165,9 @@ class RequestManager:
 
     def shed(self, req: ServeRequest, reason: str, retryable: bool = True
              ) -> None:
+        self._pressure.append(1.0)
         req.error = ShedError(reason, uid=req.uid, retryable=retryable,
-                              retry_after_s=self.retry_after_s)
+                              retry_after_s=self.current_retry_after())
         req.finish_reason = reason
         self._finish(req, SHED)
         self.counters["shed"] += 1
@@ -219,6 +241,14 @@ class RequestManager:
     def queue_depth(self) -> int:
         return len(self.queue)
 
+    def queue_depth_by_priority(self) -> Dict[int, int]:
+        """Queued requests broken down by admission priority — the router's
+        balancing signal (also ``serving/queue_depth{priority=}``)."""
+        out: Dict[int, int] = {}
+        for r in self.queue:
+            out[r.priority] = out.get(r.priority, 0) + 1
+        return out
+
     def queued_by_shed_order(self) -> List[ServeRequest]:
         return sorted(self.queue, key=ServeRequest.shed_key)
 
@@ -233,7 +263,9 @@ class RequestManager:
 
     def report(self) -> Dict:
         return {"queue_depth": self.queue_depth,
+                "queue_depth_by_priority": self.queue_depth_by_priority(),
                 "active": len(self.active),
                 "closed": self.closed,
+                "retry_after_s": round(self.current_retry_after(), 3),
                 "counters": dict(self.counters),
                 "shed_reasons": dict(self.shed_reasons)}

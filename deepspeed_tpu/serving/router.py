@@ -1,0 +1,591 @@
+"""Multi-replica serving: replica workers and the load-spreading router.
+
+Two pieces turn N single-process :class:`ContinuousBatcher` instances into
+one request plane (the FastGen/MII product-layer shape above
+``InferenceEngineV2``):
+
+* :class:`Replica` — owns ONE batcher and the only thread that ever
+  touches it. The batcher is deliberately not thread-safe (its step loop
+  is the concurrency model), so every cross-thread operation — submit,
+  cancel, drain-capture, report — travels through an inbox queue into the
+  worker loop, which interleaves command handling with ``batcher.step()``
+  and publishes per-step completions (token by token) to each request's
+  subscriber queue. That publication stream is what the HTTP front-end
+  frames as SSE events.
+
+* :class:`ReplicaRouter` — spreads submits across replicas
+  **least-loaded-first** (queue depth + active set + projected worst-case
+  KV, the same numbers ``serving_report()`` exposes), skips DRAINING
+  replicas per the readiness semantics (``/readyz`` 503 ⇒ don't route),
+  retries retryable sheds on siblings before surfacing the 429, and — the
+  drain contract — migrates a draining replica's queued-but-unstarted
+  requests onto siblings instead of letting them die with it. A migrated
+  request keeps its router uid, priority, remaining deadline, and its
+  event stream; the client never learns its replica died.
+
+SIGTERM parity with the single-replica batcher: ``install_signal_handlers``
+maps SIGTERM onto a drain (of one named replica or the whole pool) with
+migration, run from a helper thread so the signal handler itself stays
+async-safe.
+"""
+
+from __future__ import annotations
+
+import queue
+import signal
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.serving.batcher import DEGRADED, DRAINING, READY
+from deepspeed_tpu.serving.protocol import terminal_record
+from deepspeed_tpu.serving.request import CANCELLED, ServeRequest, ShedError
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["Replica", "ReplicaRouter"]
+
+
+
+class _Sub:
+    """One request's event subscription: the consumer queue plus how many
+    generated tokens have already been published to it."""
+
+    __slots__ = ("events", "sent")
+
+    def __init__(self, events: "queue.Queue"):
+        self.events = events
+        self.sent = 0
+
+
+class Replica:
+    """A named serving replica: one batcher + its single worker thread."""
+
+    def __init__(self, name: str, batcher, idle_sleep_s: float = 0.002,
+                 submit_timeout_s: float = 30.0):
+        self.name = name
+        self.batcher = batcher
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.submit_timeout_s = float(submit_timeout_s)
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.paused = False            # test hook: commands yes, steps no
+        self._subs: Dict[int, _Sub] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # written only by the worker, read lock-free by the router: a plain
+        # dict REPLACED atomically each step, never mutated in place
+        self.stats: Dict = {"health": batcher.health, "queue_depth": 0,
+                            "active": 0, "projected_kv": 0.0,
+                            "kv_occupancy": 0.0, "drained": False}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "Replica":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"dstpu-replica-{self.name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Idempotent: stop and join the worker, fail queued commands,
+        resolve live subscriptions as ``server_shutdown``, and tear down
+        the batcher's own resources (HTTP server, signal handlers)."""
+        self._stop.set()
+        self.inbox.put(None)           # wake an idle-parked worker
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        while True:                    # unblock any caller still waiting
+            try:
+                cmd = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            if cmd is not None:
+                cmd[2].set_exception(ShedError(
+                    "replica_unavailable", retryable=True,
+                    retry_after_s=1.0, detail=f"{self.name} closed"))
+        for uid, sub in list(self._subs.items()):
+            req = self.batcher.manager.result(uid)
+            if req is None:
+                rec = {"state": CANCELLED,
+                       "finish_reason": "server_shutdown", "tokens": [],
+                       "usage": {"prompt_tokens": 0,
+                                 "completion_tokens": 0},
+                       "span": None, "error": None}
+            elif req.done:
+                rec = terminal_record(req)
+            else:
+                # still live at shutdown: the END event must carry a
+                # TERMINAL state, never "decoding" — clients and drills
+                # classify outcomes by it
+                rec = terminal_record(req, state=CANCELLED,
+                                      finish_reason="server_shutdown")
+            sub.events.put({"event": "end", "replica": self.name, **rec})
+        self._subs.clear()
+        self.batcher.close()
+
+    @property
+    def health(self) -> str:
+        return self.batcher.health
+
+    @property
+    def routable(self) -> bool:
+        st = self.stats
+        return (self._thread is not None and self._thread.is_alive()
+                and st["health"] != DRAINING and not st["drained"])
+
+    def load_score(self) -> float:
+        """Lower = less loaded: queued + active requests, with projected
+        worst-case KV occupancy as the fractional tiebreak."""
+        st = self.stats
+        return st["queue_depth"] + st["active"] + float(st["projected_kv"])
+
+    # ------------------------------------------------------------------
+    # thread-safe command surface
+    # ------------------------------------------------------------------
+    def _command(self, kind: str, payload, timeout: Optional[float] = None):
+        if self._thread is None or not self._thread.is_alive():
+            raise ShedError("replica_unavailable", retryable=True,
+                            retry_after_s=1.0,
+                            detail=f"{self.name} not running")
+        fut: Future = Future()
+        self.inbox.put((kind, payload, fut))
+        try:
+            return fut.result(timeout=timeout if timeout is not None
+                              else self.submit_timeout_s)
+        except (_FutureTimeout, TimeoutError):
+            raise ShedError("replica_unavailable", retryable=True,
+                            retry_after_s=1.0,
+                            detail=f"{self.name} command {kind} timed out")
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               events: Optional["queue.Queue"] = None) -> int:
+        """Submit through the worker; returns the batcher uid. Token/end
+        events for the request are published to ``events`` (if given)
+        starting before the first step that could touch it — no token is
+        ever generated unobserved."""
+        return self._command("submit", dict(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s, priority=priority, events=events))
+
+    def cancel(self, uid: int) -> bool:
+        return self._command("cancel", uid)
+
+    def request_drain(self, reason: str = "drain"
+                      ) -> List[Tuple[ServeRequest, Optional["queue.Queue"]]]:
+        """Enter DRAINING and capture the queued-but-unstarted requests
+        (with their detached event queues) for the router to migrate.
+        In-flight requests stay and finish under the drain."""
+        return self._command("drain", reason)
+
+    def report(self) -> Dict:
+        """``serving_report()`` taken inside the worker loop, so it never
+        races a step (falls back to a direct call once the worker is
+        gone)."""
+        if self._thread is None or not self._thread.is_alive():
+            return self.batcher.serving_report()
+        return self._command("report", None)
+
+    def resolve(self, uid: int) -> Optional[str]:
+        return self._command("resolve", uid)
+
+    # ------------------------------------------------------------------
+    # worker loop (the only batcher-touching thread)
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        self._update_stats()
+        while not self._stop.is_set():
+            m = self.batcher.manager
+            idle = (self.paused or self.batcher.drained
+                    or (not m.active and not m.queue))
+            self._drain_commands(block=idle)
+            if self._stop.is_set():
+                break
+            if not self.paused and not self.batcher.drained:
+                try:
+                    self.batcher.step()
+                except Exception as e:   # a step bug must not kill serving
+                    logger.warning(f"serving: replica {self.name} step "
+                                   f"raised {e!r}")
+            self._publish()
+            self._update_stats()
+
+    def _drain_commands(self, block: bool) -> None:
+        try:
+            cmd = (self.inbox.get(timeout=self.idle_sleep_s) if block
+                   else self.inbox.get_nowait())
+        except queue.Empty:
+            return
+        while True:
+            if cmd is not None:
+                self._handle(cmd)
+            try:
+                cmd = self.inbox.get_nowait()
+            except queue.Empty:
+                return
+
+    def _handle(self, cmd) -> None:
+        kind, payload, fut = cmd
+        try:
+            if kind == "submit":
+                events = payload.pop("events")
+                uid = self.batcher.submit(payload.pop("prompt"), **payload)
+                if events is not None:
+                    self._subs[uid] = _Sub(events)
+                self._update_stats()
+                fut.set_result(uid)
+            elif kind == "cancel":
+                fut.set_result(self.batcher.manager.cancel(payload))
+            elif kind == "drain":
+                captured = []
+                for req in list(self.batcher.manager.queue):
+                    sub = self._subs.pop(req.uid, None)
+                    captured.append(
+                        (req, None if sub is None else sub.events))
+                # begin_drain sheds the queue on THIS replica; with the
+                # subscriptions detached above, those shed terminals stay
+                # silent and the router re-homes the requests instead
+                self.batcher.begin_drain(payload)
+                self._update_stats()
+                fut.set_result(captured)
+            elif kind == "report":
+                fut.set_result(self.batcher.serving_report())
+            elif kind == "resolve":
+                fut.set_result(self.batcher.manager.resolve(payload))
+            else:
+                fut.set_exception(ValueError(f"unknown command {kind}"))
+        except BaseException as e:     # noqa: BLE001 — relayed to caller
+            if not fut.done():
+                fut.set_exception(e)
+
+    def _publish(self) -> None:
+        """Feed each subscriber the tokens its request gained this step;
+        terminal requests get the full ``end`` record and drop off."""
+        mgr = self.batcher.manager
+        queued = None                  # built once, only if a sub needs it
+        for uid, sub in list(self._subs.items()):
+            req = mgr.active.get(uid) or mgr.done.get(uid)
+            if req is None:
+                if queued is None:
+                    queued = {r.uid for r in mgr.queue}
+                if uid in queued:
+                    continue           # still waiting for admission
+                del self._subs[uid]    # unknown (flushed externally)
+                continue
+            gen = req.generated
+            while sub.sent < len(gen):
+                sub.events.put({"event": "token",
+                                "token": int(gen[sub.sent]),
+                                "index": sub.sent, "replica": self.name})
+                sub.sent += 1
+            if req.done:
+                sub.events.put({"event": "end", "replica": self.name,
+                                **terminal_record(req)})
+                del self._subs[uid]
+
+    def _update_stats(self) -> None:
+        b = self.batcher
+        m = b.manager
+        self.stats = {
+            "health": b.health,
+            "queue_depth": m.queue_depth,
+            "queue_depth_by_priority": m.queue_depth_by_priority(),
+            "active": len(m.active),
+            "kv_occupancy": b.kv_occupancy,
+            "projected_kv": b._projected_blocks() / max(1, b.num_blocks),
+            "drained": b.drained,
+        }
+
+
+class _Route:
+    __slots__ = ("replica", "uid", "events", "migrations")
+
+    def __init__(self, replica: str, uid: int, events):
+        self.replica = replica
+        self.uid = uid
+        self.events = events
+        self.migrations = 0
+
+
+class ReplicaRouter:
+    """Least-loaded request routing over N :class:`Replica` workers."""
+
+    def __init__(self, replicas: Sequence[Replica], config=None,
+                 clock: Callable[[], float] = time.monotonic):
+        from deepspeed_tpu.config.config import RouterConfig
+
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.cfg = config if config is not None else RouterConfig()
+        self.replicas: Dict[str, Replica] = {r.name: r for r in replicas}
+        self.clock = clock
+        self._lock = threading.Lock()
+        # insertion-ordered; TERMINAL routes older than max_route_history
+        # are evicted so a long-running front-end does not grow
+        # per-request state forever. A still-live head pauses eviction
+        # (bounded overshoot: live routes are capped by queue+active) —
+        # a live request must never lose its route, or cancel/resolve
+        # would silently no-op on it
+        self._routes: Dict[int, _Route] = {}
+        self._route_order: Deque[int] = deque()
+        self._by_loc: Dict[Tuple[str, int], int] = {}  # (replica, uid)→ruid
+        self._next_ruid = 0
+        self._prev_sigterm = None
+        self.counters: Dict[str, int] = {
+            "routed": 0, "failover": 0, "rejected": 0, "migrated": 0,
+            "migration_failed": 0, "drains": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "ReplicaRouter":
+        for rep in self.replicas.values():
+            rep.start()
+        return self
+
+    def close(self) -> None:
+        self.restore_signal_handlers()
+        for rep in self.replicas.values():
+            rep.close()
+
+    @property
+    def health(self) -> str:
+        """Pool health for the shared ``/readyz``: ready while ANY replica
+        can take traffic; draining only when the whole pool is going away."""
+        states = [r.stats["health"] for r in self.replicas.values()]
+        if READY in states:
+            return READY
+        if DEGRADED in states:
+            return DEGRADED
+        if states and all(s == DRAINING for s in states):
+            return DRAINING
+        return "starting"
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def _ranked(self, exclude=()) -> List[Replica]:
+        """Routable replicas, least-loaded first. STARTING ranks with
+        READY (a replica that has not served yet IS the least loaded — it
+        must get traffic to ever leave STARTING); DEGRADED ranks last (it
+        runs on reduced capacity, so siblings absorb first); DRAINING is
+        excluded entirely by ``routable``."""
+        cands = [r for r in self.replicas.values()
+                 if r.name not in exclude and r.routable]
+        return sorted(cands, key=lambda r: (
+            1 if r.stats["health"] == DEGRADED else 0, r.load_score()))
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               events: Optional["queue.Queue"] = None,
+               _exclude=(), _ruid: Optional[int] = None) -> int:
+        """Route to the least-loaded replica; retry retryable sheds on
+        siblings; surface the final :class:`ShedError` (with the LARGEST
+        retry-after hint seen — the pool-wide pressure signal) only after
+        every candidate refused. Returns a router-scoped uid."""
+        attempts = 0
+        cap = self.cfg.failover_attempts or len(self.replicas)
+        last: Optional[ShedError] = None
+        hint = 0.0
+        for rep in self._ranked(exclude=_exclude):
+            if attempts >= cap:
+                break
+            attempts += 1
+            try:
+                uid = rep.submit(prompt, max_new_tokens=max_new_tokens,
+                                 deadline_s=deadline_s, priority=priority,
+                                 events=events)
+            except ShedError as e:
+                if not e.retryable:
+                    raise            # oversize etc: no sibling can help
+                last = e
+                hint = max(hint, e.retry_after_s or 0.0)
+                with self._lock:
+                    self.counters["failover"] += 1
+                continue
+            with self._lock:
+                if _ruid is None:
+                    ruid = self._next_ruid
+                    self._next_ruid += 1
+                    self._routes[ruid] = _Route(rep.name, uid, events)
+                    self._route_order.append(ruid)
+                    self.counters["routed"] += 1
+                    self._evict_terminal_routes()
+                else:                # migration keeps the client-facing uid
+                    ruid = _ruid
+                    route = self._routes[ruid]
+                    self._by_loc.pop((route.replica, route.uid), None)
+                    route.replica, route.uid = rep.name, uid
+                    route.migrations += 1
+                self._by_loc[(rep.name, uid)] = ruid
+            return ruid
+        with self._lock:
+            self.counters["rejected"] += 1
+        if last is None:
+            raise ShedError("no_replicas", retryable=True,
+                            retry_after_s=max(hint, 1.0),
+                            detail="no routable replica in the pool")
+        raise ShedError(last.reason, retryable=True,
+                        retry_after_s=max(hint, last.retry_after_s or 0.0),
+                        detail=f"all {attempts} routable replicas refused")
+
+    def cancel(self, ruid: int) -> bool:
+        route = self._routes.get(ruid)
+        if route is None:
+            return False
+        try:
+            return self.replicas[route.replica].cancel(route.uid)
+        except ShedError:
+            return False
+
+    def resolve(self, ruid: int) -> Optional[str]:
+        """Terminal/current state for a router uid — follows the route
+        through any migrations, so 'no admitted uid silently lost' is
+        checkable at the pool level exactly like at one replica."""
+        route = self._routes.get(ruid)
+        if route is None:
+            return None
+        rep = self.replicas[route.replica]
+        try:
+            return rep.resolve(route.uid)
+        except ShedError:
+            return rep.batcher.manager.resolve(route.uid)
+
+    # ------------------------------------------------------------------
+    # drain + migration
+    # ------------------------------------------------------------------
+    def drain_replica(self, name: str, reason: str = "drain") -> Dict:
+        """Drain one replica, migrating its queued-but-unstarted requests
+        onto the least-loaded siblings. Each migrated request keeps its
+        router uid, priority, remaining deadline, and event stream; in-
+        flight requests finish on the draining replica under its normal
+        drain. Requests no sibling will take resolve as retryable sheds —
+        refused loudly, never lost silently."""
+        rep = self.replicas[name]
+        with self._lock:
+            self.counters["drains"] += 1
+        captured = rep.request_drain(reason)
+        migrated = failed = 0
+        for req, events in captured:
+            ruid = self._ruid_for(name, req.uid)
+            remaining = (None if req.deadline is None
+                         else req.deadline - self.clock())
+            if remaining is not None and remaining <= 0:
+                remaining = 0.001      # let the sibling's sweep expire it
+            try:
+                if not self.cfg.migrate_on_drain:
+                    raise ShedError("draining", retryable=True,
+                                    retry_after_s=1.0,
+                                    detail="migration disabled")
+                new_ruid = self.submit(
+                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    deadline_s=remaining, priority=req.priority,
+                    events=events, _exclude=(name,),
+                    _ruid=None if ruid is None else ruid)
+                migrated += 1
+                if events is not None:
+                    # announced only once the sibling really took it (a
+                    # refused migration must read as a shed, not a move);
+                    # a first sibling token may legally precede this event
+                    with self._lock:
+                        dest = self._routes[new_ruid].replica
+                    events.put({"event": "migrated", "from": name,
+                                "to": dest})
+            except ShedError as e:
+                failed += 1
+                if events is not None:
+                    events.put({"event": "end", "replica": name,
+                                "state": "shed",
+                                "finish_reason": e.reason, "tokens": [],
+                                "usage": {"prompt_tokens": req.prompt_len,
+                                          "completion_tokens": 0},
+                                "span": req.span(),
+                                "error": {"reason": e.reason,
+                                          "retryable": e.retryable,
+                                          "retry_after_s":
+                                              e.retry_after_s}})
+        with self._lock:
+            self.counters["migrated"] += migrated
+            self.counters["migration_failed"] += failed
+        logger.warning(f"serving: router drained {name} ({reason}); "
+                       f"migrated={migrated} failed={failed} "
+                       f"in_flight_left={rep.stats['active']}")
+        return {"replica": name, "captured": len(captured),
+                "migrated": migrated, "failed": failed}
+
+    def _ruid_for(self, replica: str, uid: int) -> Optional[int]:
+        with self._lock:
+            return self._by_loc.get((replica, uid))
+
+    def _evict_terminal_routes(self) -> None:
+        """Called under ``self._lock``. Drops oldest routes past the
+        history cap, but ONLY terminal ones — reading the replica ledger's
+        ``done`` membership is a GIL-atomic dict probe, so no cross-thread
+        handshake is needed. A live head stops the sweep (O(1) amortized;
+        overshoot bounded by the number of live requests)."""
+        while (len(self._routes) > self.cfg.max_route_history
+               and self._route_order):
+            head = self._route_order[0]
+            route = self._routes.get(head)
+            if route is None:          # already gone (defensive)
+                self._route_order.popleft()
+                continue
+            rep = self.replicas.get(route.replica)
+            if rep is not None \
+                    and route.uid not in rep.batcher.manager.done:
+                break                  # oldest route still live: wait
+            self._route_order.popleft()
+            del self._routes[head]
+            self._by_loc.pop((route.replica, route.uid), None)
+
+    # ------------------------------------------------------------------
+    # signals + reporting
+    # ------------------------------------------------------------------
+    def install_signal_handlers(self, drain: Optional[str] = None) -> None:
+        """SIGTERM → drain ``drain`` (one replica) or the whole pool, with
+        queue migration, from a helper thread (a signal handler must not
+        block on worker handshakes)."""
+        names = [drain] if drain is not None else list(self.replicas)
+
+        def _on_sigterm(signum, frame):
+            logger.warning(f"serving: router SIGTERM — draining {names}")
+            threading.Thread(target=self._drain_many, args=(names,),
+                             daemon=True).start()
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def restore_signal_handlers(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    def _drain_many(self, names) -> None:
+        for n in names:
+            try:
+                self.drain_replica(n, "SIGTERM")
+            except Exception as e:
+                logger.warning(f"serving: SIGTERM drain of {n} failed: "
+                               f"{e!r}")
+
+    def report(self) -> Dict:
+        """Pool-level mirror of ``serving_report()``: per-replica reports
+        plus the routing counters."""
+        with self._lock:
+            counters = dict(self.counters)
+            routes = len(self._routes)
+        return {
+            "health": self.health,
+            "counters": counters,
+            "routes": routes,
+            "replicas": {name: rep.report()
+                         for name, rep in self.replicas.items()},
+        }

@@ -43,8 +43,37 @@ class TestRequestManager:
         e = ei.value
         assert isinstance(e, RuntimeError)      # legacy catch-surface holds
         assert e.reason == "queue_full" and e.retryable
-        assert e.retry_after_s == 2.5
+        # the hint is load-aware: base 2.5 scaled UP by the full queue
+        assert e.retry_after_s > 2.5
         assert mgr.counters["rejected"] == 1
+
+    def test_retry_after_hint_scales_with_pressure(self):
+        """Satellite: ``Retry-After`` reflects load. Idle → the configured
+        base; full queue → larger; repeated rejects (shed rate) → larger
+        still, monotonically."""
+        mgr = RequestManager(max_queue_depth=4, retry_after_s=1.0)
+        assert mgr.current_retry_after() == 1.0      # idle = base
+        for _ in range(4):
+            mgr.submit([1])
+        full = mgr.current_retry_after()
+        assert full > 1.0                            # queue fullness
+        hints = []
+        for _ in range(6):
+            with pytest.raises(ShedError) as ei:
+                mgr.submit([1])
+            hints.append(ei.value.retry_after_s)
+        assert hints[0] > full                       # reject adds shed rate
+        assert hints == sorted(hints)                # pressure only grows
+        assert hints[-1] <= 4.0                      # bounded at 4x base
+
+    def test_queue_depth_by_priority_breakdown(self):
+        mgr = RequestManager()
+        for prio in (0, 5, 0, 2):
+            mgr.submit([1], priority=prio)
+        assert mgr.queue_depth_by_priority() == {0: 2, 5: 1, 2: 1}
+        rep = mgr.report()
+        assert rep["queue_depth_by_priority"] == {0: 2, 5: 1, 2: 1}
+        assert rep["retry_after_s"] > 0
 
     def test_closed_manager_refuses_with_draining(self):
         mgr = RequestManager()
@@ -215,6 +244,31 @@ def test_serving_report_and_monitor_stream(tiny_engine, tmp_path):
     last = (outdir / "serving_completed.csv").read_text().strip(
         ).splitlines()[-1]
     assert float(last.split(",")[1]) == 3.0
+
+
+def test_per_priority_queue_depth_gauges(tiny_engine):
+    """Satellite: the queue-depth breakdown lands in the registry as
+    ``serving/queue_depth{priority=}`` children next to the unlabeled
+    total, and a priority class that empties is zeroed, not stale."""
+    from deepspeed_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=2,
+                        max_active_requests=1)
+    b = ContinuousBatcher(tiny_engine, cfg, registry=reg)
+    uids = [b.submit(np.arange(12) % 250, priority=p) for p in (0, 0, 7)]
+    assert b.step()                   # admits the head; two stay queued
+    fam = reg.get("serving/queue_depth")
+    series = {dict(i.labels).get("priority"): i.value
+              for i in fam.series.values()}
+    assert series[None] == 2.0        # unlabeled total alongside children
+    assert series["0"] == 1.0 and series["7"] == 1.0
+    assert b.serving_report()["queue_depth_by_priority"] == {0: 1, 7: 1}
+    b.pump(max_steps=60)
+    assert all(b.manager.resolve(u) == COMPLETED for u in uids)
+    series = {dict(i.labels).get("priority"): i.value
+              for i in fam.series.values()}
+    assert series["0"] == 0.0 and series["7"] == 0.0
 
 
 # ---------------------------------------------------------------------------

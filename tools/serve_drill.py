@@ -17,6 +17,7 @@ Invariants asserted after EVERY drill:
     python tools/serve_drill.py --scenario deadline-storm
     python tools/serve_drill.py --scenario shed-under-kv-pressure
     python tools/serve_drill.py --scenario sigterm-drain
+    python tools/serve_drill.py --scenario frontend-storm
 
 Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
 Slow pytest wrappers live in ``tests/unit/test_serving.py`` under the
@@ -204,10 +205,172 @@ def scenario_sigterm_drain(workdir):
     return ok, details
 
 
+def scenario_frontend_storm(workdir):
+    """Real HTTP load (stdlib client, real sockets) against a 2-replica
+    router behind the network front-end: a storm of concurrent
+    mixed-priority requests with a shed_storm fault on top, then a SIGTERM
+    drain of one replica mid-storm. Invariants: ≥1 429 with Retry-After;
+    the drained replica's queued requests migrate to the sibling; every
+    admitted router uid resolves terminal (none lost); both KV pools
+    restored; front-end/router close idempotently."""
+    import threading
+
+    from deepspeed_tpu.config.config import FrontendConfig, RouterConfig
+    from deepspeed_tpu.resilience import FaultInjector, set_injector
+    from deepspeed_tpu.serving import (FrontendError, GenerateClient,
+                                       Replica, ReplicaRouter,
+                                       ServingFrontend)
+
+    b0 = _make_batcher(max_queue_depth=8, default_max_new_tokens=3)
+    b1 = _make_batcher(max_queue_depth=8, default_max_new_tokens=3)
+    r0, r1 = Replica("r0", b0), Replica("r1", b1)
+    router = ReplicaRouter([r0, r1], RouterConfig()).start()
+    fe = ServingFrontend(router, FrontendConfig(
+        api_keys={"gold": 5}, max_header_priority=4)).start()
+    results, lock = [], threading.Lock()
+
+    def wait_for(cond, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def unary(i, key):
+        cli = GenerateClient(fe.url, api_key=key, timeout_s=180)
+        try:
+            out = cli.generate(list(range(1, 10 + i % 4)),
+                               max_new_tokens=3,
+                               priority=None if key else (i % 3))
+            with lock:
+                results.append(("ok", out))
+        except FrontendError as e:
+            with lock:
+                results.append(("err", e))
+
+    def streamer(i):
+        try:
+            evs = list(GenerateClient(fe.url, timeout_s=180).stream(
+                list(range(1, 12)), max_new_tokens=3))
+            with lock:
+                results.append(("stream", evs))
+        except FrontendError as e:
+            with lock:
+                results.append(("err", e))
+
+    timings = {}
+    try:
+        # phase 1 — storm: queues fill while the workers hold, the shed
+        # storm lands on full queues, the overflow 429s at submit time
+        r0.paused = r1.paused = True
+        threads = [threading.Thread(
+            target=unary, args=(i, "gold" if i % 5 == 0 else None))
+            for i in range(20)]
+        for t in threads:
+            t.start()
+        wait_for(lambda: r0.stats["queue_depth"] + r1.stats["queue_depth"]
+                 + sum(1 for r in results if r[0] == "err") >= 20)
+        set_injector(FaultInjector([{"kind": "shed_storm", "times": 2}]))
+        r0.paused = r1.paused = False
+        for t in threads:
+            t.join(timeout=180)
+        _fresh_injector()
+        errs_p1 = [r[1] for r in results if r[0] == "err"]
+
+        # phase 2 — SIGTERM drain of r0 mid-flight, queued work migrates
+        results.clear()
+        r0.paused = r1.paused = True
+        threads = ([threading.Thread(target=unary, args=(i, "gold"))
+                    for i in range(6)]
+                   + [threading.Thread(target=streamer, args=(i,))
+                      for i in range(6)])
+        for t in threads:
+            t.start()
+        wait_for(lambda: r0.stats["queue_depth"]
+                 + r1.stats["queue_depth"] >= 12)
+        queued_r0 = r0.stats["queue_depth"]
+        router.install_signal_handlers(drain="r0")
+        t_drain = time.monotonic()
+        os.kill(os.getpid(), signal.SIGTERM)
+        migrated_done = wait_for(
+            lambda: router.counters["migrated"]
+            + router.counters["migration_failed"] >= queued_r0)
+        timings["drain_to_migrated_s"] = round(
+            time.monotonic() - t_drain, 3)
+        r0.paused = r1.paused = False
+        for t in threads:
+            t.join(timeout=180)
+        quiesced = wait_for(
+            lambda: all(r.stats["active"] == 0
+                        and r.stats["queue_depth"] == 0
+                        for r in (r0, r1)))
+    finally:
+        _fresh_injector()
+        router.restore_signal_handlers()
+        fe.close()
+        fe.close()                    # idempotent-shutdown satellite
+        router.close()
+        router.close()
+
+    oks = [r[1] for r in results if r[0] == "ok"]
+    streams = [r[1] for r in results if r[0] == "stream"]
+    errs_p2 = [r[1] for r in results if r[0] == "err"]
+    pool0 = _invariants(b0, [])
+    pool1 = _invariants(b1, [])
+    # no admitted uid lost: every router uid either terminal in a ledger
+    # (ok/stream/end-record 429) — router.resolve follows migrations
+    admitted_ids = ([o["id"] for o in oks]
+                    + [evs[-1]["data"].get("id") for evs in streams
+                       if evs and evs[-1]["event"] == "end"]
+                    + [e.body["id"] for e in errs_p1 + errs_p2
+                       if "id" in (e.body or {})])
+    unresolved = {i: router.resolve(i) for i in admitted_ids
+                  if router.resolve(i)
+                  not in ("completed", "shed", "expired", "cancelled")}
+    got_429 = [e for e in errs_p1 if e.status == 429
+               and e.retry_after_s is not None]
+    # a phase-2 request may legitimately end shed-retryable (the sibling's
+    # queue can genuinely fill during migration — that's backpressure, not
+    # loss); what may NOT happen is a stream without a terminal end record
+    # or a uid that resolves to nothing
+    done_streams = [evs for evs in streams
+                    if evs and evs[-1]["event"] == "end"]
+    completed_streams = [evs for evs in done_streams
+                         if evs[-1]["data"]["state"] == "completed"]
+    rep = router.report()
+    details = {
+        "phase1_429": len(got_429), "phase1_errs": len(errs_p1),
+        "phase2_ok": len(oks), "phase2_streams": len(streams),
+        "phase2_streams_completed": len(completed_streams),
+        "phase2_errs": len(errs_p2),
+        "queued_r0_at_drain": queued_r0,
+        "migrated_done": migrated_done, "quiesced": quiesced,
+        "router_counters": rep["counters"], "timings": timings,
+        "unresolved_ids": unresolved,
+        "pool_r0": pool0, "pool_r1": pool1,
+    }
+    ok = (len(got_429) >= 1
+          and rep["counters"]["migrated"] >= 1
+          and migrated_done and quiesced
+          and not unresolved
+          and all(o["state"] == "completed" and len(o["tokens"]) == 3
+                  for o in oks)
+          and len(done_streams) == len(streams)
+          and all(evs[-1]["data"]["state"] in ("completed", "shed")
+                  for evs in done_streams)
+          and all(len(evs[-1]["data"]["tokens"]) == 3
+                  for evs in completed_streams)
+          and len(oks) + len(completed_streams) >= 1
+          and pool0["kv_pool_restored"] and pool1["kv_pool_restored"])
+    return ok, details
+
+
 SCENARIOS = {
     "deadline-storm": scenario_deadline_storm,
     "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
     "sigterm-drain": scenario_sigterm_drain,
+    "frontend-storm": scenario_frontend_storm,
 }
 
 
