@@ -64,10 +64,15 @@ class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.1 so mounted routes can stream chunked responses; every
     # response therefore carries Content-Length or chunked framing
     protocol_version = "HTTP/1.1"
+    #: a response (status line + headers) is on the wire for the current
+    #: request — writing a second one would corrupt a committed chunked
+    #: body, so error paths must close the connection instead
+    _committed = False
 
     def _send(self, code: int, body: str, ctype: str,
               headers: Optional[dict] = None) -> None:
         data = body.encode("utf-8")
+        self._committed = True
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
@@ -89,6 +94,15 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:         # route bug ≠ serving-process death
             logger.warning(f"observability: route {method} {path} "
                            f"failed: {e}")
+            if self._committed:
+                # the route already sent a status line (possibly mid
+                # chunked stream): a second response would be injected
+                # into the body — drop the connection instead
+                self.close_connection = True
+                return True
+            # the route may have died before consuming the request body;
+            # its unread bytes would desync a kept-alive connection
+            self.close_connection = True
             try:
                 self._send(500, json.dumps(
                     {"error": {"type": "internal", "detail": str(e)}}),
@@ -98,6 +112,7 @@ class _Handler(BaseHTTPRequestHandler):
         return True
 
     def do_POST(self):  # noqa: N802 (http.server API)
+        self._committed = False
         if not self._dispatch("POST"):
             # the unread request body would desync a kept-alive HTTP/1.1
             # connection (its bytes parse as the next request line)
@@ -105,6 +120,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, "not found\n", "text/plain")
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        self._committed = False
         srv = self.server
         path = self.path.split("?", 1)[0]
         try:
@@ -123,6 +139,11 @@ class _Handler(BaseHTTPRequestHandler):
             elif not self._dispatch("GET"):
                 self._send(404, "not found\n", "text/plain")
         except Exception as e:  # never take the serving process down
+            if self._committed:
+                logger.warning(f"observability: GET {path} failed after "
+                               f"response commit: {e}")
+                self.close_connection = True
+                return
             try:
                 self._send(500, f"scrape error: {e}\n", "text/plain")
             except OSError:
@@ -134,6 +155,7 @@ class _Handler(BaseHTTPRequestHandler):
     def begin_chunked(self, code: int = 200,
                       ctype: str = "text/event-stream",
                       headers: Optional[dict] = None) -> None:
+        self._committed = True
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Cache-Control", "no-cache")

@@ -488,9 +488,30 @@ class ContinuousBatcher:
         its API routes on the same mux. Idempotent: a second call returns
         the already-running server instead of binding a second socket —
         the first server must not leak unclosable behind the second. A
-        cached server closed externally is replaced, not returned dead."""
+        cached server closed externally is replaced, not returned dead. A
+        repeat call asking for a DIFFERENT bind address than the running
+        server's gets the running server back with a loud warning — the
+        requested address is not silently honoured."""
         if self._http_server is not None and not self._http_server.closed:
-            return self._http_server
+            import socket
+
+            srv = self._http_server
+
+            def _resolves_to_bound(h: str) -> bool:
+                if h == srv.host or srv.host in ("0.0.0.0", "::"):
+                    return True        # wildcard bind serves any host
+                try:                   # "localhost" vs the resolved
+                    return socket.gethostbyname(h) == srv.host
+                except OSError:
+                    return False
+
+            if not _resolves_to_bound(host) or (port != 0
+                                                and port != srv.port):
+                logger.warning(
+                    f"serving: metrics server already bound at {srv.url}; "
+                    f"ignoring requested bind {host}:{port} — close() it "
+                    f"first to rebind")
+            return srv
         from deepspeed_tpu.observability import ObservabilityServer
 
         self._http_server = ObservabilityServer.for_batcher(

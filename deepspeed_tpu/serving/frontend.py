@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import json
 import queue
+import select
+import socket
 import time
 from typing import Dict, Optional
 
@@ -142,7 +144,12 @@ class ServingFrontend:
         self._send_json(handler, 200, self.backend.report())
 
     def _read_body(self, handler) -> bytes:
-        length = int(handler.headers.get("Content-Length", 0) or 0)
+        try:
+            length = int(handler.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            handler.close_connection = True
+            raise ProtocolError(400, "bad_content_length",
+                                "Content-Length must be an integer")
         if length <= 0:
             # an unread (possibly chunked) body would desync keep-alive
             handler.close_connection = True
@@ -186,13 +193,27 @@ class ServingFrontend:
                 else self.cfg.request_timeout_s)
         return time.monotonic() + wait
 
-    def _cancel_quiet(self, uid) -> None:
-        """Best-effort cancel: a hung/closed backend raising its own
-        ShedError must not crash the handler (mid-stream that would write
-        a raw 500 into a committed chunked body)."""
+    def _client_gone(self, handler) -> bool:
+        """EOF-peek the connection: while a handler waits on the event
+        queue it never touches the socket, so a client disconnect is
+        otherwise invisible until the terminal send. Pipelined bytes on a
+        kept-alive connection read as data (not gone); FIN/RST read as
+        EOF/error (gone)."""
         try:
-            self._cancel_quiet(uid)
-        except ShedError:
+            r, _, _ = select.select([handler.connection], [], [], 0)
+            if not r:
+                return False
+            return handler.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _cancel_quiet(self, uid) -> None:
+        """Best-effort cancel: a hung/closed backend raising must not
+        crash the handler (mid-stream that would write a raw 500 into a
+        committed chunked body)."""
+        try:
+            self.backend.cancel(uid)
+        except Exception:
             pass
 
     def _unary_response(self, handler, uid, events, preq) -> None:
@@ -201,6 +222,11 @@ class ServingFrontend:
             try:
                 ev = events.get(timeout=_EVENT_POLL_S)
             except queue.Empty:
+                if self._client_gone(handler):
+                    # nobody is waiting for the answer: stop generating
+                    self._cancel_quiet(uid)
+                    handler.close_connection = True
+                    return
                 if time.monotonic() < deadline:
                     continue
                 # the pump stalled past any reasonable resolution point:
@@ -227,6 +253,12 @@ class ServingFrontend:
                 try:
                     ev = events.get(timeout=_EVENT_POLL_S)
                 except queue.Empty:
+                    if self._client_gone(handler):
+                        # a silent wait (e.g. still queued) hides the
+                        # disconnect from the write path — peek for it
+                        self._cancel_quiet(uid)
+                        handler.close_connection = True
+                        return
                     if time.monotonic() < deadline:
                         continue
                     self._cancel_quiet(uid)

@@ -133,7 +133,8 @@ def parse_generate_request(raw: bytes, headers, cfg) -> GenerateRequest:
                             f"tokens")
     max_new = body.get("max_new_tokens")
     if max_new is not None:
-        if not isinstance(max_new, int) or max_new < 1:
+        if not isinstance(max_new, int) or isinstance(max_new, bool) \
+                or max_new < 1:
             raise ProtocolError(400, "invalid_max_new_tokens",
                                 "max_new_tokens must be a positive int")
     deadline = body.get("deadline_s", body.get("timeout_s"))

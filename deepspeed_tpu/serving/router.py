@@ -292,10 +292,12 @@ class Replica:
     def _update_stats(self) -> None:
         b = self.batcher
         m = b.manager
+        # NOTE: no queue_depth_by_priority here — this runs every worker
+        # iteration and nothing routes on the breakdown (it is exported
+        # via serving_report() and the /metrics gauges instead)
         self.stats = {
             "health": b.health,
             "queue_depth": m.queue_depth,
-            "queue_depth_by_priority": m.queue_depth_by_priority(),
             "active": len(m.active),
             "kv_occupancy": b.kv_occupancy,
             "projected_kv": b._projected_blocks() / max(1, b.num_blocks),
@@ -423,9 +425,19 @@ class ReplicaRouter:
                     self._evict_terminal_routes()
                 else:                # migration keeps the client-facing uid
                     ruid = _ruid
-                    route = self._routes[ruid]
-                    self._by_loc.pop((route.replica, route.uid), None)
-                    route.replica, route.uid = rep.name, uid
+                    route = self._routes.get(ruid)
+                    if route is None:
+                        # evicted between drain-capture and re-home (the
+                        # draining replica sheds the capture into its done
+                        # ledger, making the route eviction-eligible):
+                        # re-insert under the SAME ruid so the client's
+                        # uid keeps resolving through the migration
+                        route = _Route(rep.name, uid, events)
+                        self._routes[ruid] = route
+                        self._route_order.append(ruid)
+                    else:
+                        self._by_loc.pop((route.replica, route.uid), None)
+                        route.replica, route.uid = rep.name, uid
                     route.migrations += 1
                 self._by_loc[(rep.name, uid)] = ruid
             return ruid
@@ -498,7 +510,8 @@ class ReplicaRouter:
                     # refused migration must read as a shed, not a move);
                     # a first sibling token may legally precede this event
                     with self._lock:
-                        dest = self._routes[new_ruid].replica
+                        r = self._routes.get(new_ruid)
+                        dest = r.replica if r is not None else "?"
                     events.put({"event": "migrated", "from": name,
                                 "to": dest})
             except ShedError as e:

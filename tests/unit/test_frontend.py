@@ -69,6 +69,7 @@ class TestProtocol:
         (json.dumps({"prompt": []}).encode(), 400),
         (json.dumps({"prompt": [1, "x"]}).encode(), 400),
         (json.dumps({"prompt": [1], "max_new_tokens": 0}).encode(), 400),
+        (json.dumps({"prompt": [1], "max_new_tokens": True}).encode(), 400),
         (json.dumps({"prompt": [1], "deadline_s": -1}).encode(), 400),
         (json.dumps({"prompt": list(range(9000))}).encode(), 413),
     ])
@@ -316,6 +317,132 @@ def test_deadline_expiry_maps_to_504(engines, clean_pools):
               and rep.stats["queue_depth"] == 0)
     finally:
         rep.close()
+
+
+# ---------------------------------------------------------------------------
+# cancellation: server timeout + client disconnect must reach backend.cancel
+# ---------------------------------------------------------------------------
+
+class _StallBackend:
+    """Admits and then never resolves: forces the front-end's server-side
+    timeout. ``cancel`` records the uid and can be armed to raise — the
+    front-end's best-effort cancel must swallow a failing backend instead
+    of crashing the handler mid-response."""
+    health = "ready"
+
+    def __init__(self, cancel_raises=None):
+        self.cancelled = []
+        self.submitted = []
+        self._raise = cancel_raises
+
+    def submit(self, prompt, *, max_new_tokens=None, deadline_s=None,
+               priority=0, events=None):
+        self.submitted.append(prompt)
+        return 42
+
+    def cancel(self, uid):
+        self.cancelled.append(uid)
+        if self._raise is not None:
+            raise self._raise
+        return True
+
+    def report(self):
+        return {}
+
+
+class _ChattyBackend(_StallBackend):
+    """Streams token events until cancelled: the handler is always
+    writing, so a client disconnect surfaces as a broken pipe."""
+
+    def submit(self, prompt, *, max_new_tokens=None, deadline_s=None,
+               priority=0, events=None):
+        def pump():
+            i = 0
+            while not self.cancelled and i < 100_000:
+                events.put({"event": "token", "token": 1, "index": i})
+                i += 1
+                time.sleep(0.001)
+
+        threading.Thread(target=pump, daemon=True).start()
+        return 42
+
+
+def test_unary_server_timeout_cancels_backend_and_maps_504():
+    be = _StallBackend()
+    with ServingFrontend(be, FrontendConfig(request_timeout_s=0.1)) as fe:
+        with pytest.raises(FrontendError) as ei:
+            GenerateClient(fe.url, timeout_s=30).generate([1, 2, 3])
+        assert ei.value.status == 504
+        assert ei.value.body["error"]["type"] == "server_timeout"
+        assert be.cancelled == [42]
+
+
+def test_stream_server_timeout_cancels_even_when_cancel_raises():
+    """The stream-timeout path must still deliver a clean terminal SSE
+    event (not a raw 500 injected into the chunked body) even when the
+    backend's cancel itself blows up with an arbitrary exception."""
+    be = _StallBackend(cancel_raises=RuntimeError("backend gone"))
+    with ServingFrontend(be, FrontendConfig(request_timeout_s=0.1)) as fe:
+        conn = http.client.HTTPConnection(fe.server.host, fe.server.port,
+                                          timeout=30)
+        conn.request("POST", GENERATE_PATH,
+                     body=json.dumps({"prompt": [1], "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        evs = list(iter_sse(resp))      # terminated chunked stream parses
+        conn.close()
+        assert be.cancelled == [42]     # cancel reached the backend...
+        assert evs[-1]["event"] == "end"   # ...and its raise stayed quiet
+        assert evs[-1]["data"]["finish_reason"] == "server_timeout"
+
+
+def test_unary_client_disconnect_cancels_backend():
+    """The unary wait never touches the socket until the terminal send —
+    the disconnect must be peeked for between event polls, or the request
+    generates to completion for nobody."""
+    be = _StallBackend()
+    with ServingFrontend(be, FrontendConfig()) as fe:
+        conn = http.client.HTTPConnection(fe.server.host, fe.server.port,
+                                          timeout=30)
+        conn.request("POST", GENERATE_PATH,
+                     body=json.dumps({"prompt": [1]}),
+                     headers={"Content-Type": "application/json"})
+        time.sleep(0.2)                 # handler is in the event wait...
+        conn.sock.close()               # ...and the client vanishes
+        conn.close()
+        _wait(lambda: be.cancelled == [42], timeout=30)
+
+
+def test_bad_content_length_maps_to_400():
+    import socket as socket_mod
+
+    be = _StallBackend()
+    with ServingFrontend(be, FrontendConfig()) as fe:
+        s = socket_mod.create_connection((fe.server.host, fe.server.port),
+                                         timeout=10)
+        s.sendall(b"POST " + GENERATE_PATH.encode() + b" HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Length: abc\r\n\r\n")
+        status = s.recv(4096).split(b"\r\n", 1)[0]
+        s.close()
+        assert b" 400 " in status, status
+        assert be.submitted == [] and be.cancelled == []
+
+
+def test_client_disconnect_mid_stream_cancels_backend():
+    be = _ChattyBackend()
+    with ServingFrontend(be, FrontendConfig()) as fe:
+        conn = http.client.HTTPConnection(fe.server.host, fe.server.port,
+                                          timeout=30)
+        conn.request("POST", GENERATE_PATH,
+                     body=json.dumps({"prompt": [1], "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read(64)                   # stream is live...
+        conn.sock.close()               # ...then the client vanishes
+        conn.close()
+        _wait(lambda: be.cancelled == [42], timeout=30)
 
 
 def test_router_routes_away_from_draining_and_fails_over(

@@ -213,6 +213,44 @@ class TestExposition:
             assert code == 200 and json.loads(body)["x/g"]
             assert _get(srv.url + "/nope")[0] == 404
 
+    def test_route_error_before_commit_maps_to_500(self):
+        srv = ObservabilityServer(MetricsRegistry())
+        srv.mount("GET", "/boom", lambda h: 1 / 0)
+        with srv:
+            code, body = _get(srv.url + "/boom")
+            assert code == 500
+            assert json.loads(body)["error"]["type"] == "internal"
+
+    def test_route_error_after_commit_drops_connection_not_inject_500(self):
+        """A mounted route that dies AFTER committing a chunked response
+        must not get a second (500) response written into the stream body
+        — the connection is dropped, so the client sees a truncated chunk
+        stream rather than a desynced/corrupted one."""
+        import http.client
+
+        srv = ObservabilityServer(MetricsRegistry())
+
+        def boom(handler):
+            handler.begin_chunked(200, "text/event-stream")
+            handler.write_chunk(b"data: one\n\n")
+            raise RuntimeError("mid-stream failure")
+
+        srv.mount("GET", "/boom", boom)
+        with srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=10)
+            conn.request("GET", "/boom")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            try:
+                raw = resp.read()
+            except (http.client.IncompleteRead, ConnectionError) as e:
+                raw = getattr(e, "partial", b"")
+            conn.close()
+            assert b"data: one" in raw             # the committed bytes
+            assert b"500" not in raw               # no raw status line
+            assert b"internal" not in raw          # no injected error body
+
 
 # ---------------------------------------------------------------------------
 # profile trigger lifecycle (stubbed capture fns; the real-jax.profiler
@@ -372,6 +410,9 @@ def test_batcher_populates_slo_histograms_and_probes(tiny_engine):
     assert rep["latency_ms"]["samples"] == b.counters["engine_steps"]
     # /metrics + probes over real HTTP, mapped from batcher health
     with b.serve_metrics_http() as srv:
+        # a repeat call — even asking for a different bind — returns the
+        # running server (with a warning) instead of binding a second one
+        assert b.serve_metrics_http(port=srv.port + 1) is srv
         code, body = _get(srv.url + "/metrics")
         assert code == 200
         samples = validate_prometheus(body)

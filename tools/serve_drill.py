@@ -325,9 +325,9 @@ def scenario_frontend_storm(workdir):
                        if evs and evs[-1]["event"] == "end"]
                     + [e.body["id"] for e in errs_p1 + errs_p2
                        if "id" in (e.body or {})])
-    unresolved = {i: router.resolve(i) for i in admitted_ids
-                  if router.resolve(i)
-                  not in ("completed", "shed", "expired", "cancelled")}
+    resolved = {i: router.resolve(i) for i in admitted_ids}
+    unresolved = {i: st for i, st in resolved.items()
+                  if st not in ("completed", "shed", "expired", "cancelled")}
     got_429 = [e for e in errs_p1 if e.status == 429
                and e.retry_after_s is not None]
     # a phase-2 request may legitimately end shed-retryable (the sibling's
