@@ -1,0 +1,176 @@
+"""PR 9 verification drive: prefix-cache KV reuse + n-gram speculative
+decoding, through the PUBLIC surface (config block -> engine kwargs ->
+ContinuousBatcher -> /metrics), the way a user would wire it.
+
+Run from /root/repo:  python _verify_pr9.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference import (BlockedAllocator, CapacityError,  # noqa: E402
+                                     InferenceEngineV2)
+from deepspeed_tpu.models import TransformerLM, get_preset  # noqa: E402
+from deepspeed_tpu.serving import ContinuousBatcher  # noqa: E402
+
+PASS = []
+
+
+def check(name, ok, detail=""):
+    PASS.append((name, bool(ok)))
+    print(f"{'PASS' if ok else 'FAIL'}  {name}" + (f"  [{detail}]" if detail else ""))
+    if not ok:
+        sys.exit(f"verification failed at: {name}")
+
+
+# ---- 1. config surface: the inference block parses, validates, and reaches
+#         the engine ------------------------------------------------------
+cfg_json = {
+    "train_batch_size": 8,
+    "serving": {"enabled": True, "prefill_chunk": 32,
+                "default_max_new_tokens": 8},
+    "inference": {
+        "prefix_cache": {"enabled": True},
+        "speculative": {"enabled": True, "ngram": 2, "max_draft": 4,
+                        "fallback_steps": 4},
+    },
+}
+with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+    json.dump(cfg_json, f)
+    cfg_path = f.name
+cfg = deepspeed_tpu.from_config(cfg_path)
+check("from_config parses inference block",
+      cfg.inference.prefix_cache.enabled
+      and cfg.inference.speculative.max_draft == 4)
+
+for bad, field in (({"speculative": {"enabled": True, "max_draft": 0}},
+                    "max_draft"),
+                   ({"prefix_cache": {"enabled": True, "max_blocks": 0}},
+                    "max_blocks"),
+                   ({"speculative": {"enabled": True, "ngram": 0}}, "ngram")):
+    try:
+        deepspeed_tpu.from_config({"train_batch_size": 8, "inference": bad})
+        check(f"bad config rejected ({field})", False)
+    except Exception as e:  # pydantic ValidationError names the field
+        check(f"bad config rejected ({field})", field in str(e), str(e)[:60])
+
+model = TransformerLM(get_preset("tiny", dtype="float32"))
+params = model.init(jax.random.key(0))
+try:
+    InferenceEngineV2(model, params=params, max_sequences=2, max_seq_len=64,
+                      prefix_cache=True, paged=False)
+    check("prefix_cache needs packed engine", False)
+except ValueError as e:
+    check("prefix_cache needs packed engine", "packed" in str(e))
+
+# ---- 2. refcounted allocator: double-free raises ------------------------
+alloc = BlockedAllocator(4, 8)
+blocks = alloc.allocate(2)
+alloc.free(blocks)
+try:
+    alloc.free(blocks)
+    check("double-free raises", False)
+except RuntimeError as e:
+    check("double-free raises", "double free" in str(e))
+
+# ---- 3. serving: shared system prompt, exactness vs a plain batcher,
+#         metrics on /metrics, pool restoration ---------------------------
+rng = np.random.default_rng(0)
+system = rng.integers(0, 250, 48)
+prompts = [np.concatenate([system, rng.integers(0, 250, 6)])
+           for _ in range(4)]
+
+
+def serve(eng):
+    b = ContinuousBatcher.from_deepspeed_config(eng, cfg)
+    outs = []
+    for p in prompts:
+        uid = b.submit(p)
+        b.pump(max_steps=200)
+        outs.append([int(t) for t in b.manager.done[uid].generated])
+    return b, outs
+
+
+plain = InferenceEngineV2(model, params=params, max_sequences=8,
+                          max_seq_len=128, block_size=16)
+_, base = serve(plain)
+feat = InferenceEngineV2(model, params=params, max_sequences=8,
+                         max_seq_len=128, block_size=16,
+                         prefix_cache=cfg.inference.prefix_cache,
+                         speculative=cfg.inference.speculative)
+b, got = serve(feat)
+check("warm tokens identical to cold baseline", got == base)
+rep = b.serving_report()
+check("prefix hits on repeated system prompt",
+      rep["counters"]["prefix_hit_requests"] == 3
+      and rep["counters"]["prefix_hit_tokens"] == 144,
+      str(rep["counters"]["prefix_hit_tokens"]))
+check("spec rounds ran", rep["speculative"]["rounds"] > 0,
+      str(rep["speculative"]))
+check("report carries prefix/spec sections",
+      rep["prefix_cache"]["hit_tokens"] == 144
+      and "reclaimable_blocks" in rep["kv"])
+
+srv = b.serve_metrics_http()
+text = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+check("registry families on /metrics",
+      "inference_prefix_cache_hit_tokens" in text.replace("/", "_")
+      or "inference/prefix_cache_hit_tokens" in text, text[:0] or "scraped")
+b.close()
+
+feat.prefix_cache.clear()
+a = feat.state.allocator
+check("pool restored, zero refcounts leaked",
+      a.free_blocks == a.num_blocks and not a.leaked_blocks())
+
+# ---- 4. engine-level: speculative greedy decode is token-identical and
+#         accepts drafts on repetitive text -------------------------------
+eng = InferenceEngineV2(model, params=params, max_sequences=4,
+                        max_seq_len=128, block_size=16,
+                        speculative=cfg.inference.speculative)
+rep_prompt = np.tile([5, 6, 7, 8], 8)
+r = eng.put([1], [rep_prompt])
+t = int(np.argmax(r[1]))
+ref = [int(x) for x in eng.decode_batch([1], [t], steps=20,
+                                        speculative=False)[1]]
+eng.flush([1])
+eng.put([2], [rep_prompt])
+got = [int(x) for x in eng.decode_batch([2], [t], steps=20,
+                                        speculative=True)[2]]
+check("spec greedy token-identical", got == ref)
+s = eng.spec_stats
+check("drafts accepted on repetitive text",
+      s["accepted"] > 0 and s["emitted"] / max(1, s["rounds"]) > 1.0,
+      str(s))
+
+# typed overload surface survives the spec path
+tight = InferenceEngineV2(model, params=params, max_sequences=2,
+                          max_seq_len=600, block_size=8, num_blocks=4,
+                          speculative=True)
+try:
+    tight.put([9], [np.zeros(160, np.int32)])
+    check("CapacityError still typed", False)
+except CapacityError as e:
+    check("CapacityError still typed", e.uids == [9])
+
+# ---- 5. the drill CLI is the end-to-end authority -----------------------
+rc = subprocess.call([sys.executable, "tools/serve_drill.py",
+                      "--scenario", "prefix-storm"],
+                     stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+check("prefix-storm drill exits 0", rc == 0)
+
+print(f"\nall {len(PASS)} checks passed")

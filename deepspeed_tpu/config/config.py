@@ -626,6 +626,69 @@ class ServingConfig(DSTpuConfigModel):
         return self
 
 
+class PrefixCacheConfig(DSTpuConfigModel):
+    """``inference.prefix_cache``: cross-request KV reuse over the paged
+    block pool (``deepspeed_tpu/inference/ragged.py`` :class:`PrefixCache`)
+    — a radix tree of full-block token chunks lets a request whose prompt
+    repeats a resident prefix attach those blocks and prefill only the
+    uncached suffix. Blocks held only by the tree are evicted LRU under
+    pool pressure; blocks a live sequence shares are never evicted or
+    written through."""
+
+    enabled: bool = False
+    # cap on tree-held blocks (None = bounded by the pool itself, with LRU
+    # reclaim whenever live sequences need the space)
+    max_blocks: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.max_blocks is not None and self.max_blocks < 1:
+            raise ValueError(
+                "inference.prefix_cache.max_blocks must be >= 1")
+        return self
+
+
+class SpeculativeConfig(DSTpuConfigModel):
+    """``inference.speculative``: self-drafting (prompt-lookup / n-gram)
+    speculative decoding inside the engine's decode paths — draft up to
+    ``max_draft`` tokens from the sequence's own history, verify them in
+    one batched forward, accept the longest model-confirmed prefix. Greedy
+    output is token-identical to the non-speculative path; sampling
+    (temperature > 0) bypasses speculation."""
+
+    enabled: bool = False
+    ngram: int = 3          # longest trailing n-gram matched (backs off to 1)
+    max_draft: int = 4      # drafted tokens per verify round (K)
+    # fused-scan chunk when NO sequence has a draft: small enough that
+    # drafting retries soon after the history starts repeating, large
+    # enough that non-repetitive text still amortizes dispatch
+    fallback_steps: int = 8
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.ngram < 1:
+            raise ValueError("inference.speculative.ngram must be >= 1")
+        if not (1 <= self.max_draft <= 64):
+            raise ValueError(
+                "inference.speculative.max_draft must be in [1, 64]")
+        if self.fallback_steps < 1:
+            raise ValueError(
+                "inference.speculative.fallback_steps must be >= 1")
+        return self
+
+
+class InferenceConfig(DSTpuConfigModel):
+    """``inference`` section: engine-level serving performance features
+    (consumed by :class:`~deepspeed_tpu.inference.engine_v2.
+    InferenceEngineV2` via its ``prefix_cache=`` / ``speculative=``
+    kwargs)."""
+
+    prefix_cache: PrefixCacheConfig = Field(
+        default_factory=PrefixCacheConfig)
+    speculative: SpeculativeConfig = Field(
+        default_factory=SpeculativeConfig)
+
+
 class ProfileTriggerConfig(DSTpuConfigModel):
     """``observability.profile``: on-demand ``jax.profiler`` capture armed
     from outside a running job (trigger file or SIGUSR2) — see
@@ -713,6 +776,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    inference: InferenceConfig = Field(default_factory=InferenceConfig)
     observability: ObservabilityConfig = Field(
         default_factory=ObservabilityConfig)
     data_efficiency: DataEfficiencyConfig = Field(

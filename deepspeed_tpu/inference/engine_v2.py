@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.quant import QUANT_LEAVES
-from deepspeed_tpu.inference.ragged import CapacityError, SequenceManager
+from deepspeed_tpu.inference.ragged import (CapacityError, PrefixCache,
+                                            SequenceManager)
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -40,7 +41,8 @@ class InferenceEngineV2:
                  num_blocks: Optional[int] = None, paged: bool = True,
                  packed: bool = True, topology=None,
                  mesh: Optional[dict] = None, kv_dtype: str = "bf16",
-                 weight_dtype: str = "bf16"):
+                 weight_dtype: str = "bf16", prefix_cache=None,
+                 speculative=None):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from deepspeed_tpu.parallel import build_mesh
@@ -164,6 +166,56 @@ class InferenceEngineV2:
             self.cache = model.init_kv_cache(max_sequences, self.max_seq_len)
             self._step = jax.jit(model.forward_with_cache)
         self.packed = packed and paged
+        # ---- prefix-cache KV reuse + n-gram speculative decoding ----------
+        from deepspeed_tpu.config.config import (PrefixCacheConfig,
+                                                 SpeculativeConfig)
+
+        def _coerce(cls, v):
+            if v is None or isinstance(v, cls):
+                return v if v is not None else cls()
+            if isinstance(v, bool):
+                return cls(enabled=v)
+            return cls(**dict(v))
+
+        self.prefix_cfg = _coerce(PrefixCacheConfig, prefix_cache)
+        self.spec_cfg = _coerce(SpeculativeConfig, speculative)
+        if (self.prefix_cfg.enabled or self.spec_cfg.enabled) \
+                and not self.packed:
+            raise ValueError("prefix_cache / speculative need the packed "
+                             "paged engine (paged=True, packed=True)")
+        self.prefix_cache: Optional[PrefixCache] = None
+        if self.prefix_cfg.enabled:
+            from deepspeed_tpu.observability import get_registry
+
+            r = get_registry()
+            inst = {
+                "hits": r.counter("inference/prefix_cache_hits",
+                                  "requests that attached a cached prefix"),
+                "misses": r.counter("inference/prefix_cache_misses",
+                                    "prefix lookups that matched nothing"),
+                "hit_tokens": r.counter(
+                    "inference/prefix_cache_hit_tokens",
+                    "prompt tokens served from cached KV (prefill skipped)"),
+                "evictions": r.counter(
+                    "inference/prefix_cache_evictions",
+                    "cached blocks evicted (LRU, under pool pressure)"),
+                "blocks": r.gauge("inference/prefix_cache_blocks",
+                                  "blocks currently held by the prefix tree"),
+            }
+            self.prefix_cache = PrefixCache(
+                self.state.allocator, max_blocks=self.prefix_cfg.max_blocks,
+                instruments=inst)
+            self.state.prefix_cache = self.prefix_cache
+        # per-uid committed-token history: needed to key prefix publication
+        # and to self-draft n-grams; None when both features are off so the
+        # hot path pays nothing
+        self._hist: Optional[Dict[int, np.ndarray]] = (
+            {} if (self.prefix_cfg.enabled or self.spec_cfg.enabled)
+            else None)
+        self.spec_stats: Dict[str, int] = {
+            "rounds": 0, "drafted": 0, "accepted": 0, "emitted": 0,
+            "fallback_steps": 0,
+        }
 
     _QUANT_LEAVES = QUANT_LEAVES
 
@@ -204,6 +256,64 @@ class InferenceEngineV2:
                 else:
                     self.cache["pos"] = self.cache["pos"].at[seq.slot].set(0)
             self.state.flush(uid)
+            if self._hist is not None:
+                self._hist.pop(uid, None)
+
+    # ---- prefix-cache KV reuse -------------------------------------------
+    def prefix_attach(self, uid: int, tokens) -> int:
+        """Attach the longest cached full-block prefix of ``tokens`` to the
+        FRESH sequence ``uid`` (shared blocks, reference taken) and position
+        it so the engine prefills only the uncached suffix. Capped at
+        ``len(tokens) - 1`` so at least one token always runs through the
+        model (the forward that yields the first next-token logits); the
+        partial tail block is recomputed rather than copied — logical
+        copy-on-write without a device block copy. Returns matched tokens
+        (0 = miss or feature off)."""
+        if self.prefix_cache is None or uid in self.state.sequences:
+            return 0
+        toks = np.atleast_1d(np.asarray(tokens, np.int32))
+        if len(toks) < 2:
+            return 0
+        blocks, n = self.prefix_cache.acquire(toks, max_tokens=len(toks) - 1)
+        if n == 0:
+            return 0
+        try:
+            seq = self.state.attach_prefix(uid, blocks, n)
+        except BaseException:
+            # slot exhaustion (or any attach failure): give back acquire's
+            # references before surfacing — leaked refs would pin the
+            # blocks (refcount >= 2) out of the evictable set forever
+            self.state.allocator.free(blocks)
+            raise
+        self._pos[seq.slot] = n
+        if self._hist is not None:
+            self._hist[uid] = toks[:n].copy()
+        return n
+
+    def _commit(self, uid: int, fed) -> None:
+        """Commit one scheduled chunk: advance ``seen_tokens``, extend the
+        per-uid token history, and publish newly completed full blocks to
+        the prefix tree (shared from then on; never written again — decode
+        continues past them, block-aligned)."""
+        self.state.commit(uid)
+        if self._hist is not None:
+            arr = np.atleast_1d(np.asarray(fed, np.int32))
+            h = self._hist.get(uid)
+            self._hist[uid] = (arr.copy() if h is None
+                               else np.concatenate([h, arr]))
+        if self.prefix_cache is not None:
+            seq = self.state.sequences.get(uid)
+            if seq is not None:
+                n_full = seq.seen_tokens // self.block_size
+                if n_full > seq.published:
+                    self.prefix_cache.insert(
+                        self._hist[uid][:n_full * self.block_size],
+                        seq.blocks[:n_full])
+                    seq.published = n_full
+
+    def prefix_cache_report(self) -> Optional[Dict]:
+        return (None if self.prefix_cache is None
+                else self.prefix_cache.report())
 
     # incremental block-table cache: rows refresh only when a sequence's
     # block count changed or its slot was reused (SequenceManager bumps
@@ -308,14 +418,35 @@ class InferenceEngineV2:
     def decode_batch(self, batch_uids: Sequence[int],
                      batch_tokens: Sequence[int], steps: int,
                      temperature: float = 0.0, top_k: int = 0,
-                     top_p: float = 1.0, seed: int = 0
+                     top_p: float = 1.0, seed: int = 0,
+                     speculative: Optional[bool] = None
                      ) -> Dict[int, np.ndarray]:
         """Advance every listed sequence ``steps`` tokens by on-device decode
         (greedy at ``temperature=0``, else the v1 engine's temperature/
         top-k/nucleus sampling), starting from each sequence's
         ``batch_tokens`` entry. Returns the generated tokens per uid
         ([steps] each). One dispatch + one fetch regardless of ``steps`` —
-        the throughput serving mode."""
+        the throughput serving mode.
+
+        With ``inference.speculative`` enabled (overridable per call via
+        ``speculative=``) greedy decode runs draft-verify rounds: up to
+        ``max_draft`` tokens self-drafted by n-gram lookup in the sequence's
+        own history, verified in one batched forward, the longest correct
+        prefix accepted — token-identical output, fewer forward passes.
+        Sampling always takes the fused-scan path."""
+        spec = (self.spec_cfg.enabled if speculative is None
+                else bool(speculative))
+        if spec and temperature == 0.0 and self._hist is not None:
+            return self._decode_batch_spec(batch_uids, batch_tokens, steps)
+        return self._decode_batch_scan(batch_uids, batch_tokens, steps,
+                                       temperature, top_k, top_p, seed)
+
+    def _decode_batch_scan(self, batch_uids: Sequence[int],
+                           batch_tokens: Sequence[int], steps: int,
+                           temperature: float = 0.0, top_k: int = 0,
+                           top_p: float = 1.0, seed: int = 0
+                           ) -> Dict[int, np.ndarray]:
+        """The fused on-device decode scan (one dispatch for ``steps``)."""
         if not (self.paged and self.packed):
             raise ValueError("decode_batch needs the packed paged engine")
         if not self.state.can_schedule_batch(batch_uids,
@@ -341,8 +472,221 @@ class InferenceEngineV2:
             toks = np.asarray(out)            # [steps, bpad]
         for i, d in enumerate(descs):
             self._pos[d.slot] = d.seen_tokens + steps
-            self.state.commit(d.uid)
+            # fed tokens = the start token + all but the last output (the
+            # scan feeds its own outputs; the final one's KV is not yet in)
+            fed = (np.concatenate([tok0[i:i + 1], toks[:-1, i]])
+                   if self._hist is not None else ())
+            self._commit(d.uid, fed)
         return {d.uid: toks[:, i] for i, d in enumerate(descs)}
+
+    # ---- n-gram speculative decoding (draft + batched verify) ------------
+    def _draft(self, uids: Sequence[int], tokens: Sequence[int],
+               caps: Sequence[int]) -> list:
+        """Per-uid draft arrays from each sequence's own committed history
+        plus the token about to be fed (prompt-lookup decoding)."""
+        from deepspeed_tpu.inference.speculative import ngram_draft
+
+        drafts = []
+        for uid, t, cap in zip(uids, tokens, caps):
+            seq = self.state.sequences.get(uid)
+            room = self.max_seq_len - (seq.seen_tokens if seq else 0) - 1
+            k = min(int(self.spec_cfg.max_draft), int(cap), room)
+            h = self._hist.get(uid)
+            hist = (np.concatenate([h, [t]]) if h is not None and h.size
+                    else np.asarray([t], np.int32))
+            drafts.append(ngram_draft(hist, self.spec_cfg.ngram, k)
+                          if k > 0 else hist[:0])
+        return drafts
+
+    def draft_tokens(self, batch_uids: Sequence[int],
+                     batch_tokens: Sequence[int],
+                     max_drafts: Optional[Sequence[int]] = None) -> list:
+        """Host-side n-gram drafts per uid (possibly empty arrays) — lets a
+        caller route draft-less sequences through the ordinary decode path
+        and pay the verify dispatch only where a draft exists."""
+        if self._hist is None:
+            raise ValueError("draft_tokens needs inference.speculative "
+                             "(or prefix_cache) enabled on the engine")
+        caps = (max_drafts if max_drafts is not None
+                else [self.spec_cfg.max_draft] * len(batch_uids))
+        return self._draft(batch_uids, batch_tokens, caps)
+
+    def spec_decode_round(self, batch_uids: Sequence[int],
+                          batch_tokens: Sequence[int],
+                          max_drafts: Optional[Sequence[int]] = None,
+                          drafts: Optional[list] = None):
+        """One greedy draft-verify round for every listed sequence: draft up
+        to ``min(max_draft, max_drafts[i])`` tokens by n-gram lookup (or
+        take precomputed ``drafts``), verify all drafts in ONE batched
+        forward, accept the longest prefix the model confirms (plus the
+        model's own bonus token at the frontier). Returns
+        ``({uid: emitted int32 array (1..K+1 tokens)}, info)`` where
+        ``info`` carries the round's drafted/accepted/emitted counts — the
+        acceptance-rate feed for ``serving/spec_*``."""
+        if drafts is None:
+            drafts = self.draft_tokens(batch_uids, batch_tokens, max_drafts)
+        elif self._hist is None:
+            raise ValueError("spec_decode_round needs inference.speculative "
+                             "(or prefix_cache) enabled on the engine")
+        return self._spec_verify(batch_uids, batch_tokens, drafts)
+
+    def _pack_atoms(self, descs, chunks):
+        """The packed two-region atom layout (decode rows, then pow2-wide
+        tile atoms) shared by :meth:`put` and :meth:`_spec_verify` — the
+        two MUST agree because they feed the same ``_step_packed`` jit.
+        Returns ``(tok_ids, tok_slot, tok_pos, valid, starts, dr, tile,
+        no_past)`` where ``starts[i]`` is the packed row of chunk ``i``'s
+        first token."""
+        items = list(enumerate(zip(descs, chunks)))
+        dec = [(i, d, c) for i, (d, c) in items if len(c) == 1]
+        big = [(i, d, c) for i, (d, c) in items if len(c) > 1]
+        n_dec = len(dec)
+        dr = max(8, 1 << (n_dec - 1).bit_length()) if n_dec else 0
+        if big:
+            longest = max(len(c) for _, _, c in big)
+            tile = max(_MIN_TILE, 1 << (longest - 1).bit_length())
+            tpad = 1 << (len(big) - 1).bit_length()
+        else:
+            tile, tpad = self.module.MAX_ATOM, 0
+        npad = dr + tpad * tile
+        tok_ids = np.zeros((npad,), np.int32)
+        tok_slot = np.zeros((npad,), np.int32)
+        tok_pos = np.zeros((npad,), np.int32)
+        valid = np.zeros((npad,), bool)
+        starts = np.zeros((len(descs),), np.int32)
+        off = 0
+        for i, d, c in dec:
+            tok_ids[off] = c[0]
+            tok_slot[off] = d.slot
+            tok_pos[off] = d.seen_tokens
+            valid[off] = True
+            starts[i] = off
+            off += 1
+        off = dr
+        for i, d, c in big:                  # one whole-chunk atom each
+            tok_ids[off:off + len(c)] = c
+            tok_slot[off:off + tile] = d.slot
+            tok_pos[off:off + len(c)] = d.seen_tokens + np.arange(len(c))
+            valid[off:off + len(c)] = True
+            starts[i] = off
+            off += tile
+        # when every chunk atom starts at position 0 (fresh prefill) the
+        # past kernel is statically skipped — the common first-put case
+        no_past = all(d.seen_tokens == 0 for _, d, c in big)
+        return tok_ids, tok_slot, tok_pos, valid, starts, dr, tile, no_past
+
+    def _spec_verify(self, batch_uids, batch_tokens, drafts):
+        """Verify per-sequence chunks ``[t0, d1..dk]`` in one packed step
+        with logits gathered at EVERY chunk position, then accept greedily.
+        KV for rejected drafts lands in the pool but the frontier
+        (``seen_tokens``/``_pos``) only advances over accepted tokens, so
+        later steps overwrite the stale rows before any read reaches them
+        (pool reads are bounded by the frontier)."""
+        chunks = [np.concatenate([[int(t)], np.asarray(d, np.int64)])
+                  .astype(np.int32)
+                  for t, d in zip(batch_tokens, drafts)]
+        lens = [len(c) for c in chunks]
+        if not self.state.can_schedule_batch(batch_uids, lens):
+            raise CapacityError(batch_uids, lens, "spec verify round")
+        descs = [self.state.schedule(uid, n)
+                 for uid, n in zip(batch_uids, lens)]
+        tok_ids, tok_slot, tok_pos, valid, starts, dr, tile, no_past = \
+            self._pack_atoms(descs, chunks)
+        # gather logits at EVERY chunk position (not just ends), chunk-major,
+        # padded to a power of two so the jit cache stays bounded
+        G = sum(lens)
+        gpad = max(8, 1 << (G - 1).bit_length())
+        gidx = np.zeros((gpad,), np.int32)
+        goff = np.zeros((len(descs),), np.int32)
+        g = 0
+        for i, c in enumerate(chunks):
+            goff[i] = g
+            gidx[g:g + len(c)] = starts[i] + np.arange(len(c))
+            g += len(c)
+        with jax.sharding.set_mesh(self.mesh):
+            logits, self.cache = self._step_packed(
+                self.params, jnp.asarray(tok_ids), self.cache,
+                jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
+                jnp.asarray(tok_pos), jnp.asarray(valid),
+                jnp.asarray(gidx), dr, tile, no_past)
+            out = np.asarray(logits)                       # [gpad, V]
+        results: Dict[int, np.ndarray] = {}
+        info = {"drafted": int(G - len(descs)), "accepted": 0, "emitted": 0,
+                "nonfinite_uids": []}
+        for i, (d, c) in enumerate(zip(descs, chunks)):
+            lg = out[goff[i]:goff[i] + len(c)]             # [len(c), V]
+            if not np.all(np.isfinite(np.asarray(lg, np.float32))):
+                # argmax over NaN would silently emit token 0; commit only
+                # t0 (its KV is in the pool either way) and flag the uid so
+                # the serving layer resolves it loudly like the put() path
+                d.in_flight = 1
+                self._pos[d.slot] = d.seen_tokens + 1
+                self._commit(d.uid, c[:1])
+                results[d.uid] = np.asarray([int(np.argmax(lg[0]))],
+                                            np.int32)
+                info["nonfinite_uids"].append(d.uid)
+                info["emitted"] += 1
+                continue
+            emitted = [int(np.argmax(lg[0]))]
+            j = 1
+            while j < len(c) and int(c[j]) == emitted[-1]:
+                emitted.append(int(np.argmax(lg[j])))
+                j += 1
+            m = len(emitted)        # fed tokens confirmed in KV: c[:m]
+            d.in_flight = m
+            self._pos[d.slot] = d.seen_tokens + m
+            self._commit(d.uid, c[:m])
+            results[d.uid] = np.asarray(emitted, np.int32)
+            info["accepted"] += m - 1
+            info["emitted"] += m
+        self.spec_stats["rounds"] += 1
+        self.spec_stats["drafted"] += info["drafted"]
+        self.spec_stats["accepted"] += info["accepted"]
+        self.spec_stats["emitted"] += info["emitted"]
+        return results, info
+
+    def _decode_batch_spec(self, batch_uids, batch_tokens, steps: int
+                           ) -> Dict[int, np.ndarray]:
+        """Greedy decode via draft-verify rounds; rounds where no sequence
+        has a draft fall back to the fused scan (power-of-two step chunks,
+        bounding compile churn). Output is token-identical to
+        ``_decode_batch_scan`` — only the number of dispatches changes."""
+        B = len(batch_uids)
+        # same demand as the scan path: draft caps are remaining-1, so a
+        # round schedules at most `remaining` tokens and the highest
+        # position ever written is seen + steps - 1 — speculation changes
+        # the number of dispatches, never the capacity contract
+        if not self.state.can_schedule_batch(batch_uids, [steps] * B):
+            raise CapacityError(batch_uids, [steps] * B, "decode_batch")
+        out: Dict[int, list] = {u: [] for u in batch_uids}
+        remaining = {u: steps for u in batch_uids}
+        cur = {u: int(t) for u, t in zip(batch_uids, batch_tokens)}
+        while True:
+            live = [u for u in batch_uids if remaining[u] > 0]
+            if not live:
+                break
+            caps = [remaining[u] - 1 for u in live]
+            drafts = self._draft(live, [cur[u] for u in live], caps)
+            if not any(len(d) for d in drafts):
+                n = min(min(remaining[u] for u in live),
+                        int(self.spec_cfg.fallback_steps))
+                n = 1 << (n.bit_length() - 1)       # pow2: bounded jit cache
+                res = self._decode_batch_scan(live,
+                                              [cur[u] for u in live], n)
+                self.spec_stats["fallback_steps"] += n
+                for u in live:
+                    toks = [int(t) for t in res[u]]
+                    out[u].extend(toks)
+                    remaining[u] -= n
+                    cur[u] = toks[-1]
+                continue
+            res, _ = self._spec_verify(live, [cur[u] for u in live], drafts)
+            for u in live:
+                toks = [int(t) for t in res[u]]
+                out[u].extend(toks)
+                remaining[u] -= len(toks)
+                cur[u] = toks[-1]
+        return {u: np.asarray(out[u], np.int32) for u in batch_uids}
 
     def _fresh(self, uid: int) -> bool:
         seq = self.state.sequences.get(uid)
@@ -436,7 +780,7 @@ class InferenceEngineV2:
         for i, (d, c) in enumerate(zip(descs, chunks)):
             results[d.uid] = out[i]
             self._pos[d.slot] = d.seen_tokens + len(c)
-            self.state.commit(d.uid)
+            self._commit(d.uid, c)
         return results
 
     # ---- one continuous-batching step (engine_v2.py:107 parity) ----------
@@ -445,11 +789,32 @@ class InferenceEngineV2:
         """Advance every listed sequence by its token chunk; returns next-token
         logits per uid. Chunks may be whole prompts (prefill), single decode
         tokens, or anything between — per-slot cache positions make the batch
-        ragged in effect while dense in shape."""
+        ragged in effect while dense in shape. With ``inference.prefix_cache``
+        enabled, a fresh multi-token chunk first attaches any cached
+        full-block prefix and only its uncached suffix is prefilled."""
         assert len(batch_uids) == len(batch_tokens)
         t_put = time.perf_counter()
         self.timing = {}        # never report a previous put's numbers
         chunks = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if self.prefix_cache is not None \
+                and any(len(c) > 1 and u not in self.state.sequences
+                        for u, c in zip(batch_uids, chunks)) \
+                and self.state.can_schedule_batch(
+                    batch_uids, [len(c) for c in chunks]):
+            # auto-attach only when the batch is schedulable COLD: put()
+            # must stay side-effect-free when it raises CapacityError (a
+            # rejected fresh uid must leave no sequence state behind), and
+            # attaching strictly reduces demand, so a cold pass guarantees
+            # every later capacity check in this call passes too. A batch
+            # that fits only BECAUSE of the cache can prefix_attach()
+            # explicitly first (the batcher does, at admission).
+            trimmed = []
+            for uid, c in zip(batch_uids, chunks):
+                n = (self.prefix_attach(uid, c)
+                     if len(c) > 1 and uid not in self.state.sequences
+                     else 0)
+                trimmed.append(c[n:] if n else c)
+            chunks = trimmed
         if self.packed and chunks and all(len(c) > 1 for c in chunks) \
                 and max(len(c) for c in chunks) <= self.module.PREFILL_MAX \
                 and all(self._fresh(uid) for uid in batch_uids):
@@ -490,43 +855,13 @@ class InferenceEngineV2:
             # whole-chunk atom (its KV blocks are DMA'd once; its own tokens
             # attend from VMEM so the step's appends hoist out of the layer
             # scan). Region sizes and the atom width are bucketed to powers
-            # of two so the jit cache stays O(log^2) entries.
-            items = list(enumerate(zip(descs, chunks)))
-            dec = [(i, d, c) for i, (d, c) in items if len(c) == 1]
-            big = [(i, d, c) for i, (d, c) in items if len(c) > 1]
-            n_dec = len(dec)
-            dr = max(8, 1 << (n_dec - 1).bit_length()) if n_dec else 0
-            if big:
-                longest = max(len(c) for _, _, c in big)
-                tile = max(_MIN_TILE, 1 << (longest - 1).bit_length())
-                tpad = 1 << (len(big) - 1).bit_length()
-            else:
-                tile, tpad = self.module.MAX_ATOM, 0
-            npad = dr + tpad * tile
-            tok_ids = np.zeros((npad,), np.int32)
-            tok_slot = np.zeros((npad,), np.int32)
-            tok_pos = np.zeros((npad,), np.int32)
-            valid = np.zeros((npad,), bool)
+            # of two so the jit cache stays O(log^2) entries. Layout shared
+            # with the spec-verify path via _pack_atoms.
+            tok_ids, tok_slot, tok_pos, valid, starts, dr, tile, no_past = \
+                self._pack_atoms(descs, chunks)
             gather_idx = np.zeros((Bs,), np.int32)
-            off = 0
-            for i, d, c in dec:
-                tok_ids[off] = c[0]
-                tok_slot[off] = d.slot
-                tok_pos[off] = d.seen_tokens
-                valid[off] = True
-                gather_idx[i] = off              # chunk end → next-token logits
-                off += 1
-            off = dr
-            for i, d, c in big:                  # one whole-chunk atom each
-                tok_ids[off:off + len(c)] = c
-                tok_slot[off:off + tile] = d.slot
-                tok_pos[off:off + len(c)] = d.seen_tokens + np.arange(len(c))
-                valid[off:off + len(c)] = True
-                gather_idx[i] = off + len(c) - 1
-                off += tile
-            # when every chunk atom starts at position 0 (fresh prefill) the
-            # past kernel is statically skipped — the common first-put case
-            no_past = all(d.seen_tokens == 0 for _, d, c in big)
+            for i, c in enumerate(chunks):       # chunk end → next-token
+                gather_idx[i] = starts[i] + len(c) - 1
             t_host = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step_packed(
@@ -555,7 +890,7 @@ class InferenceEngineV2:
             for i, (d, c) in enumerate(zip(descs, chunks)):
                 results[d.uid] = out[i]
                 self._pos[d.slot] = d.seen_tokens + len(c)
-                self.state.commit(d.uid)
+                self._commit(d.uid, c)
             return results
 
         t_max = max(len(c) for c in chunks)
@@ -584,7 +919,7 @@ class InferenceEngineV2:
             for i, (d, c) in enumerate(zip(descs, chunks)):
                 results[d.uid] = out[i]
                 self._pos[d.slot] = d.seen_tokens + len(c)
-                self.state.commit(d.uid)
+                self._commit(d.uid, c)
             return results
 
         valid = np.zeros((Bs, t_max), bool)
@@ -598,7 +933,7 @@ class InferenceEngineV2:
         for i, (d, c) in enumerate(zip(descs, chunks)):
             results[d.uid] = out[i]
             new_pos[d.slot] = d.seen_tokens + len(c)
-            self.state.commit(d.uid)
+            self._commit(d.uid, c)
         # padded rows advanced pos by t_max; restore true per-slot positions
         self.cache = {"k": new_cache["k"], "v": new_cache["v"],
                       "pos": jnp.asarray(new_pos)}

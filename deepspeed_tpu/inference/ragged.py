@@ -7,12 +7,20 @@ ragged batch metadata (``ragged_wrapper.py``). These are host-side Python (the
 reference keeps them in C++ for speed; descriptor math here is trivially cheap next to
 a TPU step, so Python is the right tool — the device-side layout work lives in the
 paged attention kernel).
+
+Beyond the reference: the allocator is REFCOUNTED and a :class:`PrefixCache`
+(radix tree over full-block token chunks, SGLang-RadixAttention-style) lets
+engines share resident KV blocks across requests that repeat the same prompt
+prefix. Shared blocks are never written through (engines only ever write at
+positions past the shared prefix, which is block-aligned) and never freed
+while any owner remains; blocks held only by the cache are *evictable* — the
+manager reclaims them LRU-first when the free list runs short.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,12 +47,24 @@ class CapacityError(RuntimeError):
 
 
 class BlockedAllocator:
-    """Fixed-size block free-list (blocked_allocator.py parity)."""
+    """Fixed-size block free-list (blocked_allocator.py parity), refcounted.
+
+    ``allocate`` hands out blocks at refcount 1; ``incref`` registers an
+    additional owner (a prefix-cache node or a second sequence sharing the
+    block); ``free`` drops one reference and only returns the block to the
+    free list at refcount 0. Freeing a block that is already free raises —
+    a silent double-free would hand the same physical block to two
+    sequences and corrupt both."""
 
     def __init__(self, num_blocks: int, block_size: int = 128):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free: List[int] = list(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
+        # refcount-transition hook (block, old_rc, new_rc) -> None: lets
+        # the PrefixCache keep an O(1) evictable-block counter instead of
+        # walking its tree inside every schedulability query
+        self._observer: Optional[Callable[[int, int, int], None]] = None
 
     @property
     def free_blocks(self) -> int:
@@ -54,10 +74,281 @@ class BlockedAllocator:
         if n > len(self._free):
             raise RuntimeError(f"out of KV blocks: want {n}, have {len(self._free)}")
         out, self._free = self._free[:n], self._free[n:]
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks: List[int]) -> None:
-        self._free.extend(blocks)
+    def incref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise RuntimeError(f"incref of unallocated block {b}")
+            self._refs[b] += 1
+            if self._observer is not None:
+                self._observer(b, self._refs[b] - 1, self._refs[b])
+
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per listed block; blocks reaching refcount 0
+        return to the free list. Raises on double-free instead of silently
+        ``extend``-ing the free list (which would let one physical block be
+        allocated to two sequences)."""
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise RuntimeError(
+                    f"double free of KV block {b} (refcount already 0)")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+            if self._observer is not None:
+                self._observer(b, self._refs[b] + 1, self._refs[b])
+
+    def leaked_blocks(self) -> List[int]:
+        """Blocks still referenced — empty iff the pool is fully restored
+        (drill invariant helper)."""
+        return [b for b, r in enumerate(self._refs) if r > 0]
+
+
+class _PrefixNode:
+    __slots__ = ("key", "block", "children", "parent", "stamp")
+
+    def __init__(self, key: bytes, block: int, parent: "_PrefixNode"):
+        self.key = key
+        self.block = block
+        self.children: Dict[bytes, _PrefixNode] = {}
+        self.parent = parent
+        self.stamp = 0
+
+
+class PrefixCache:
+    """Radix tree over FULL-BLOCK token chunks → resident KV block ids
+    (SGLang RadixAttention over the paged pool).
+
+    Each node maps one ``block_size``-token chunk (keyed by the chunk's
+    int32 bytes, so a node's path from the root IS the token prefix) to the
+    physical block that holds its KV. The cache holds one reference on every
+    published block; sequences that :meth:`acquire` a prefix hold their own.
+    A block whose only reference is the cache's is *evictable* — eviction is
+    LRU leaf-first (evicting an interior node would orphan its children:
+    their prefix could then match without its parent being resident).
+
+    Partial tail blocks are never cached: matching stops at the last full
+    block, so the first position a consumer writes is block-aligned and lands
+    in a private block — sharing needs no device-side copy-on-write, the
+    uncached tail is simply recomputed (copy-on-write by recompute)."""
+
+    def __init__(self, allocator: BlockedAllocator,
+                 max_blocks: Optional[int] = None,
+                 instruments: Optional[Dict[str, object]] = None):
+        self.allocator = allocator
+        self.block_size = allocator.block_size
+        self.max_blocks = max_blocks
+        self._root: Dict[bytes, _PrefixNode] = {}
+        self._nodes = 0
+        self._clock = 0
+        # O(1) evictability accounting: _tracked is the set of tree-held
+        # blocks, _evictable counts those at refcount 1 (cache is the sole
+        # owner). Kept exact through the allocator's refcount-transition
+        # observer — a sequence flushing its shared prefix (2 -> 1) or a
+        # new sharer attaching (1 -> 2) flips evictability without the
+        # cache being on the call path.
+        self._tracked: set = set()
+        self._evictable = 0
+        allocator._observer = self._on_ref_transition
+        # plain counters (always on) + optional registry instruments
+        self.counters: Dict[str, int] = {
+            "hits": 0, "misses": 0, "hit_tokens": 0,
+            "inserted_blocks": 0, "evicted_blocks": 0,
+        }
+        self._inst = instruments or {}
+
+    # ------------------------------------------------------------------
+    def _key(self, chunk: np.ndarray) -> bytes:
+        return np.ascontiguousarray(chunk, np.int32).tobytes()
+
+    def _walk(self, tokens: np.ndarray, max_tokens: Optional[int]
+              ) -> List[_PrefixNode]:
+        toks = np.atleast_1d(np.asarray(tokens, np.int32))
+        limit = len(toks) if max_tokens is None else min(len(toks),
+                                                         int(max_tokens))
+        n_chunks = limit // self.block_size
+        path: List[_PrefixNode] = []
+        children = self._root
+        for i in range(n_chunks):
+            key = self._key(toks[i * self.block_size:(i + 1) * self.block_size])
+            node = children.get(key)
+            if node is None:
+                break
+            path.append(node)
+            children = node.children
+        return path
+
+    # ------------------------------------------------------------------
+    def peek(self, tokens, max_tokens: Optional[int] = None
+             ) -> Tuple[List[int], int]:
+        """Longest cached full-block prefix of ``tokens`` WITHOUT taking
+        references (admission math). Returns (block ids, matched tokens)."""
+        path = self._walk(tokens, max_tokens)
+        return [n.block for n in path], len(path) * self.block_size
+
+    def acquire(self, tokens, max_tokens: Optional[int] = None
+                ) -> Tuple[List[int], int]:
+        """Longest cached full-block prefix, with one reference taken per
+        matched block (the caller now co-owns them; release via
+        ``allocator.free`` exactly like privately allocated blocks)."""
+        path = self._walk(tokens, max_tokens)
+        blocks = [n.block for n in path]
+        if blocks:
+            self.allocator.incref(blocks)
+            self._clock += 1
+            for n in path:
+                n.stamp = self._clock
+            self.counters["hits"] += 1
+            self.counters["hit_tokens"] += len(blocks) * self.block_size
+            if "hits" in self._inst:
+                self._inst["hits"].inc()
+                self._inst["hit_tokens"].inc(
+                    float(len(blocks) * self.block_size))
+        else:
+            self.counters["misses"] += 1
+            if "misses" in self._inst:
+                self._inst["misses"].inc()
+        return blocks, len(blocks) * self.block_size
+
+    def insert(self, tokens, blocks: Sequence[int]) -> int:
+        """Publish the KV blocks holding ``tokens`` (full blocks only; both
+        truncated to full-block granularity). Idempotent: chunks already in
+        the tree just get their LRU stamp refreshed — an equal-content block
+        from a second sequence is NOT swapped in (the resident one keeps
+        serving). Returns the number of newly published blocks (each takes
+        one cache-owned reference)."""
+        toks = np.atleast_1d(np.asarray(tokens, np.int32))
+        n_chunks = min(len(toks) // self.block_size, len(blocks))
+        children = self._root
+        parent: Optional[_PrefixNode] = None
+        path: List[_PrefixNode] = []
+        added = 0
+        self._clock += 1
+        for i in range(n_chunks):
+            key = self._key(toks[i * self.block_size:(i + 1) * self.block_size])
+            node = children.get(key)
+            if node is None:
+                # at the cap, make room — but never by evicting a node on
+                # the path we are descending (the new node would attach to
+                # a detached parent: an unreachable subtree whose cache
+                # references could never be released again)
+                if self.max_blocks is not None \
+                        and self._nodes >= self.max_blocks \
+                        and self.evict(1, exclude=path) == 0:
+                    break        # at cap and nothing evictable: stop publishing
+                node = _PrefixNode(key, int(blocks[i]), parent)
+                self.allocator.incref([node.block])   # publisher holds one
+                self._tracked.add(node.block)         # ref, so rc >= 2 here
+                children[key] = node
+                self._nodes += 1
+                added += 1
+            node.stamp = self._clock
+            path.append(node)
+            parent = node
+            children = node.children
+        if added:
+            self.counters["inserted_blocks"] += added
+            if "blocks" in self._inst:
+                self._inst["blocks"].set(float(self._nodes))
+        return added
+
+    # ------------------------------------------------------------------
+    def _on_ref_transition(self, block: int, old_rc: int,
+                           new_rc: int) -> None:
+        """Allocator hook keeping ``_evictable`` exact in O(1): a tree-held
+        block becomes evictable when its last co-owner leaves (2 -> 1) and
+        stops being evictable when a sharer attaches (1 -> 2). All other
+        transitions leave evictability unchanged."""
+        if block in self._tracked:
+            if old_rc == 2 and new_rc == 1:
+                self._evictable += 1
+            elif old_rc == 1 and new_rc == 2:
+                self._evictable -= 1
+
+    @property
+    def held_blocks(self) -> int:
+        """Blocks the tree references (evictable + pinned-by-sharers)."""
+        return self._nodes
+
+    def evictable_blocks(self) -> int:
+        """Blocks reclaimable right now: cache-held blocks no live sequence
+        references (refcount 1 nodes are downward-closed — a pinned child
+        implies a pinned parent, since sequences hold whole prefixes — so
+        every refcount-1 node is reachable by leaf-first eviction). O(1):
+        maintained through the allocator's refcount-transition observer
+        because this sits inside every schedulability query."""
+        return self._evictable
+
+    def _iter_nodes(self):
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def evict(self, want: int, exclude: Sequence[_PrefixNode] = ()) -> int:
+        """Evict up to ``want`` blocks, LRU leaf-first; never touches a
+        block another owner still references, nor a node in ``exclude``
+        (insert's descent path). One tree walk gathers ALL current
+        candidates per pass (sorted by LRU stamp) instead of rescanning
+        the tree per freed block; parents that become evictable leaves are
+        picked up by the next pass. Returns blocks actually freed."""
+        skip = {id(n) for n in exclude}
+        freed = 0
+        while freed < want:
+            cands = [n for n in self._iter_nodes()
+                     if not n.children and id(n) not in skip
+                     and self.allocator.refcount(n.block) == 1]
+            if not cands:
+                break
+            cands.sort(key=lambda n: n.stamp)
+            for victim in cands:
+                if freed >= want:
+                    break
+                self._detach(victim)
+                self._tracked.discard(victim.block)
+                self._evictable -= 1        # victim was rc==1 by selection
+                self.allocator.free([victim.block])
+                freed += 1
+        if freed:
+            self.counters["evicted_blocks"] += freed
+            if "evictions" in self._inst:
+                self._inst["evictions"].inc(float(freed))
+            if "blocks" in self._inst:
+                self._inst["blocks"].set(float(self._nodes))
+        return freed
+
+    def _detach(self, node: _PrefixNode) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._root)
+        siblings.pop(node.key, None)
+        self._nodes -= 1
+
+    def clear(self) -> int:
+        """Drop every cached prefix, releasing the cache's references (live
+        sequences keep theirs). Returns blocks whose cache reference was
+        dropped."""
+        nodes = list(self._iter_nodes())
+        self._tracked.clear()           # before free: no transition counts
+        for n in nodes:
+            self.allocator.free([n.block])
+        self._root = {}
+        self._nodes = 0
+        self._evictable = 0             # empty tree: nothing evictable
+        if "blocks" in self._inst:
+            self._inst["blocks"].set(0.0)
+        return len(nodes)
+
+    def report(self) -> Dict[str, int]:
+        return {"blocks": self._nodes,
+                "evictable_blocks": self.evictable_blocks(),
+                **self.counters}
 
 
 @dataclasses.dataclass
@@ -69,11 +360,17 @@ class SequenceDescriptor:
     seen_tokens: int = 0           # tokens already in KV
     blocks: List[int] = dataclasses.field(default_factory=list)
     in_flight: int = 0
+    published: int = 0             # leading blocks already in the prefix tree
 
 
 class SequenceManager:
     """Tracks live sequences and KV capacity; answers schedulability queries
-    (``DSStateManager`` ragged_manager.py:19 / ``can_schedule`` engine_v2.py:184)."""
+    (``DSStateManager`` ragged_manager.py:19 / ``can_schedule`` engine_v2.py:184).
+
+    With a :class:`PrefixCache` attached (``prefix_cache``), capacity
+    queries count cache-evictable blocks as available and ``schedule``
+    reclaims them LRU-first when the free list runs short — a warm cache
+    never blocks real work, it just loses its least-recently-hit entries."""
 
     def __init__(self, max_sequences: int, max_seq_len: int, block_size: int = 128,
                  num_blocks: Optional[int] = None):
@@ -83,12 +380,19 @@ class SequenceManager:
             num_blocks if num_blocks is not None
             else max_sequences * ((max_seq_len + block_size - 1) // block_size),
             block_size)
+        self.prefix_cache: Optional[PrefixCache] = None
         self.sequences: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(max_sequences))
         # bumped whenever a slot is released: lets engines cache per-slot
         # derived state (block-table rows) and detect slot reuse even when
         # the new occupant happens to have the same block count
         self.slot_generation = [0] * max_sequences
+
+    def _available_blocks(self) -> int:
+        free = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.evictable_blocks()
+        return free
 
     def get_or_create(self, uid: int) -> SequenceDescriptor:
         if uid in self.sequences:
@@ -99,9 +403,25 @@ class SequenceManager:
         self.sequences[uid] = seq
         return seq
 
+    def attach_prefix(self, uid: int, blocks: Sequence[int],
+                      n_tokens: int) -> SequenceDescriptor:
+        """Start a FRESH sequence that co-owns ``blocks`` (already
+        referenced for it, e.g. by ``PrefixCache.acquire``) holding its
+        first ``n_tokens`` tokens of KV. The engine prefills only the
+        suffix; ``flush`` releases shared and private blocks through the
+        same refcounted path."""
+        if uid in self.sequences:
+            raise RuntimeError(f"attach_prefix on live uid {uid}")
+        if n_tokens % self.allocator.block_size:
+            raise ValueError("cached prefixes are full-block granular")
+        seq = self.get_or_create(uid)
+        seq.blocks = list(blocks)
+        seq.seen_tokens = int(n_tokens)
+        seq.published = len(seq.blocks)
+        return seq
+
     def can_schedule(self, uid: int, new_tokens: int) -> bool:
         seq = self.sequences.get(uid)
-        have = len(seq.blocks) * self.allocator.block_size if seq else 0
         seen = seq.seen_tokens if seq else 0
         if seen + new_tokens > self.max_seq_len:
             return False
@@ -109,32 +429,46 @@ class SequenceManager:
             0, -(-(seen + new_tokens) // self.allocator.block_size)
             - (len(seq.blocks) if seq else 0))
         slots_ok = uid in self.sequences or bool(self._free_slots)
-        return slots_ok and need_blocks <= self.allocator.free_blocks
+        return slots_ok and need_blocks <= self._available_blocks()
 
     def can_schedule_batch(self, uids, n_tokens) -> bool:
         """Joint schedulability: per-uid checks can each pass while the
         AGGREGATE block demand exceeds the pool — scheduling would then fail
         midway with earlier uids' blocks already taken. Engines gate every
-        multi-sequence step on this."""
+        multi-sequence step on this. A uid appearing twice in one batch is
+        costed cumulatively (each occurrence advances that uid's projected
+        tokens/blocks), not each against the original ``seen_tokens``."""
+        tok: Dict[int, int] = {}
+        blk: Dict[int, int] = {}
+        new_slots = set()
         need = 0
-        new_slots = 0
+        bs = self.allocator.block_size
         for uid, n in zip(uids, n_tokens):
-            seq = self.sequences.get(uid)
-            seen = seq.seen_tokens if seq else 0
-            if seen + n > self.max_seq_len:
+            if uid not in tok:
+                seq = self.sequences.get(uid)
+                tok[uid] = seq.seen_tokens if seq else 0
+                blk[uid] = len(seq.blocks) if seq else 0
+                if seq is None:
+                    new_slots.add(uid)
+            tok[uid] += n
+            if tok[uid] > self.max_seq_len:
                 return False
-            if seq is None:
-                new_slots += 1
-            need += max(0, -(-(seen + n) // self.allocator.block_size)
-                        - (len(seq.blocks) if seq else 0))
-        return (new_slots <= len(self._free_slots)
-                and need <= self.allocator.free_blocks)
+            grow = -(-tok[uid] // bs) - blk[uid]
+            if grow > 0:
+                need += grow
+                blk[uid] += grow
+        return (len(new_slots) <= len(self._free_slots)
+                and need <= self._available_blocks())
 
     def schedule(self, uid: int, new_tokens: int) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
         needed = -(-(seq.seen_tokens + new_tokens) // self.allocator.block_size)
-        if needed > len(seq.blocks):
-            seq.blocks.extend(self.allocator.allocate(needed - len(seq.blocks)))
+        grow = needed - len(seq.blocks)
+        if grow > 0:
+            short = grow - self.allocator.free_blocks
+            if short > 0 and self.prefix_cache is not None:
+                self.prefix_cache.evict(short)
+            seq.blocks.extend(self.allocator.allocate(grow))
         seq.in_flight = new_tokens
         return seq
 
@@ -144,7 +478,9 @@ class SequenceManager:
         seq.in_flight = 0
 
     def flush(self, uid: int) -> None:
-        """Release a finished sequence (engine ``flush`` parity)."""
+        """Release a finished sequence (engine ``flush`` parity). Shared
+        blocks just lose this sequence's reference — the prefix tree (or a
+        concurrent sequence) keeps them resident."""
         seq = self.sequences.pop(uid, None)
         if seq is not None:
             self.allocator.free(seq.blocks)

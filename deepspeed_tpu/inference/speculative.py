@@ -1,0 +1,46 @@
+"""Self-drafting (prompt-lookup / n-gram) speculative decoding support.
+
+No draft model: drafts come from the sequence's OWN token history — when the
+last ``ngram`` tokens have occurred before (system prompts, quoted context,
+code, and the repetition loops greedy decode falls into), the tokens that
+followed that earlier occurrence are proposed as the next ``max_draft``
+tokens. The engine verifies all drafts in ONE batched forward on the MXU
+(``InferenceEngineV2.spec_decode_round``) and accepts the longest prefix the
+model itself would have produced, so greedy output is exactly the
+non-speculative output — speculation only changes how many forward passes it
+takes to produce it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ngram_draft"]
+
+
+def ngram_draft(history, ngram: int, max_draft: int) -> np.ndarray:
+    """Draft up to ``max_draft`` tokens by prompt lookup.
+
+    Finds the most recent earlier occurrence of the history's trailing
+    n-gram (backing off ``ngram`` → 1) and returns the tokens that followed
+    it. Returns an empty array when the history never repeats — the caller
+    falls back to plain decode for the round."""
+    h = np.atleast_1d(np.asarray(history)).ravel()
+    L = int(h.size)
+    if L < 2 or max_draft < 1:
+        return h[:0]
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    for m in range(min(int(ngram), L - 1), 0, -1):
+        pat = h[L - m:]
+        body = h[:L - 1]                      # exclude the trailing n-gram itself
+        if body.size < m:
+            continue
+        win = sliding_window_view(body, m)
+        eq = np.flatnonzero((win == pat).all(axis=1))
+        if eq.size:
+            s = int(eq[-1])                   # most recent occurrence
+            cont = h[s + m: s + m + int(max_draft)]
+            if cont.size:
+                return np.asarray(cont, h.dtype)
+    return h[:0]

@@ -76,10 +76,33 @@ class ServingMetrics:
                                        "requests on the engine")
         self.kv_occupancy = r.gauge("serving/kv_occupancy",
                                     "paged KV pool occupancy [0, 1]")
+        # speculative decoding (n-gram draft + batched verify): acceptance
+        # rate is the headline — accepted/drafted over the process lifetime
+        self.spec_rounds = r.counter(
+            "serving/spec_rounds", "draft-verify rounds run")
+        self.spec_draft_tokens = r.counter(
+            "serving/spec_draft_tokens", "tokens drafted by n-gram lookup")
+        self.spec_accepted_tokens = r.counter(
+            "serving/spec_accepted_tokens",
+            "drafted tokens the model confirmed")
+        self.spec_acceptance_rate = r.gauge(
+            "serving/spec_acceptance_rate",
+            "lifetime accepted/drafted draft tokens")
         self._terminals: Dict[str, object] = {}
         self._sheds: Dict[str, object] = {}
         self._rejects: Dict[str, object] = {}
         self._qdepth_prio: Dict[str, object] = {}
+
+    def record_spec_round(self, drafted: int, accepted: int) -> None:
+        self.spec_rounds.inc()
+        if drafted:
+            self.spec_draft_tokens.inc(float(drafted))
+        if accepted:
+            self.spec_accepted_tokens.inc(float(accepted))
+        total_d = self.spec_draft_tokens.value
+        if total_d:
+            self.spec_acceptance_rate.set(
+                self.spec_accepted_tokens.value / total_d)
 
     # label-set children are created on first use and cached: terminal
     # states and shed reasons are small closed sets, so the dict stays tiny

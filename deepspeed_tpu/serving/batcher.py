@@ -132,6 +132,9 @@ class ContinuousBatcher:
         self.counters: Dict[str, int] = {
             "engine_steps": 0, "idle_steps": 0, "step_failures": 0,
             "decode_tokens": 0, "prefill_tokens": 0, "degraded_entries": 0,
+            "prefix_hit_requests": 0, "prefix_hit_tokens": 0,
+            "spec_rounds": 0, "spec_draft_tokens": 0,
+            "spec_accepted_tokens": 0,
         }
 
     @classmethod
@@ -166,12 +169,50 @@ class ContinuousBatcher:
         return self.num_blocks - self.engine.state.allocator.free_blocks
 
     @property
+    def reclaimable_blocks(self) -> int:
+        """Blocks held ONLY by the prefix tree: evictable on demand."""
+        pc = getattr(self.engine, "prefix_cache", None)
+        return pc.evictable_blocks() if pc is not None else 0
+
+    @property
+    def cache_blocks(self) -> int:
+        """Blocks the prefix tree references, whether or not a live
+        sequence also shares them."""
+        pc = getattr(self.engine, "prefix_cache", None)
+        return pc.held_blocks if pc is not None else 0
+
+    @property
     def kv_occupancy(self) -> float:
-        return self.used_blocks / max(1, self.num_blocks)
+        """Occupancy that counts against watermarks: pool space NOT
+        available for new work = used minus cache blocks that are evictable
+        on demand (refcount 1). A shared prefix a live sequence pins counts
+        ONCE — it genuinely consumes headroom (and shedding its sharers
+        would return it to evictable) — while a merely-warm cache is free
+        capacity in waiting, not load."""
+        return ((self.used_blocks - self.reclaimable_blocks)
+                / max(1, self.num_blocks))
 
     def _blocks_for(self, tokens: int) -> int:
         bs = self.engine.state.allocator.block_size
         return -(-int(tokens) // bs)
+
+    def _blocks_needed(self, req) -> int:
+        """Worst-case NEW blocks a queued request needs: its full demand
+        minus whatever prompt prefix is already resident in the cache — a
+        90%-cached request is nearly free and should admit as such. (The
+        peeked blocks can be evicted before the request reaches the engine;
+        admission is worst-case-projection math already, and the engine
+        re-matches at attach time.)"""
+        demand = req.total_token_demand
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is not None and req.prompt_len > 1:
+            demand -= pc.peek(req.prompt,
+                              max_tokens=req.prompt_len - 1)[1]
+        return self._blocks_for(demand)
+
+    def _spec_enabled(self) -> bool:
+        cfgs = getattr(self.engine, "spec_cfg", None)
+        return bool(cfgs is not None and cfgs.enabled)
 
     def _capacity_factor(self) -> float:
         return (self.cfg.degraded_capacity_factor
@@ -218,7 +259,10 @@ class ContinuousBatcher:
         see the same pre-admission pool and jointly overcommit it, only to
         strand each other mid-generation under kv_pressure sheds."""
         seqs = self.engine.state.sequences
-        proj = self.used_blocks
+        # evictable (refcount-1) cache blocks are not load; blocks pinned
+        # by live sharers count once — subtracting ALL tree blocks would
+        # hide pinned KV from the budget and overcommit the pool
+        proj = self.used_blocks - self.reclaimable_blocks
         for r in self.manager.active.values():
             held = len(seqs[r.uid].blocks) if r.uid in seqs else 0
             proj += max(0, self._blocks_for(r.total_token_demand) - held)
@@ -231,10 +275,13 @@ class ContinuousBatcher:
         proj = self._projected_blocks()
         while mgr.queue and len(mgr.active) < self._max_active_eff():
             req = mgr.queue[0]
-            need = self._blocks_for(req.total_token_demand)
+            # prefix-aware: only the UNCACHED share of the demand counts
+            need = self._blocks_needed(req)
             if req.total_token_demand > self.engine.max_seq_len \
-                    or need > self.num_blocks * self.cfg.kv_high_watermark:
-                # can never fit, at any load — terminal, not retryable
+                    or self._blocks_for(req.total_token_demand) \
+                    > self.num_blocks * self.cfg.kv_high_watermark:
+                # can never fit, at any load (the cache is transient, so
+                # oversize is judged on the full demand) — terminal
                 mgr.shed(req, "oversize", retryable=False)
                 continue
             if proj + need > budget:
@@ -247,7 +294,22 @@ class ContinuousBatcher:
                     continue
                 break          # FIFO head-of-line: don't starve big requests
             mgr.admit(req)
-            proj += need
+            if getattr(self.engine, "prefix_cache", None) is not None:
+                hit = self.engine.prefix_attach(req.uid, req.prompt)
+                if hit:
+                    # the cached prefix is already in KV: prefill starts at
+                    # the suffix, and TTFT shrinks by the cached fraction
+                    req.prefilled = hit
+                    self.counters["prefix_hit_requests"] += 1
+                    self.counters["prefix_hit_tokens"] += hit
+            # O(1) exact projection update for hit and miss alike: the
+            # admitted request's remaining need plus the blocks its attach
+            # just pinned out of the reclaimable set sum to its full
+            # worst-case footprint (the attach is full-block granular). A
+            # prefix another ACTIVE request already pinned double-counts
+            # until the next sweep's fresh _projected_blocks() — the
+            # conservative direction
+            proj += self._blocks_for(req.total_token_demand)
 
     def _plan(self) -> List[ServeRequest]:
         """The step's participants: every decoding request (1 token) and
@@ -258,10 +320,14 @@ class ContinuousBatcher:
         batch = self.manager.decoding() + self.manager.prefilling()
         if not batch:
             return []
+        spec = self._spec_enabled()
 
         def demand(r):
-            return 1 if r.state == DECODING else min(
-                chunk, r.prompt_len - r.prefilled)
+            if r.state == DECODING:
+                # a spec round schedules up to 1 + K tokens (drafts verify
+                # into KV even when rejected) — plan for the worst case
+                return 1 + self._spec_cap(r) if spec else 1
+            return min(chunk, r.prompt_len - r.prefilled)
 
         while batch and not self.engine.state.can_schedule_batch(
                 [r.uid for r in batch], [demand(r) for r in batch]):
@@ -271,20 +337,15 @@ class ContinuousBatcher:
             self.manager.shed(victim, "capacity")
         return batch
 
-    def _advance(self, req: ServeRequest, fed: int, logits) -> None:
-        """Commit one put()'s outcome for one request. The argmax of this
-        step's logits IS a generated token, counted and completion-checked
-        immediately — a request's last token never rides an extra decode
-        step (whose logits would be discarded) just to be recorded."""
-        if req.state == PREFILLING:
-            req.prefilled += fed
-            self.counters["prefill_tokens"] += fed
-            if req.prefilled < req.prompt_len:
-                return
-            req.state = DECODING
-        else:
-            self.counters["decode_tokens"] += 1
-        nxt = int(np.argmax(np.asarray(logits)))
+    def _spec_cap(self, req: ServeRequest) -> int:
+        """Max drafts worth verifying for this request: never draft past
+        ``max_new_tokens`` (emitted per round ≤ drafts + 1)."""
+        cap = req.max_new_tokens - len(req.generated) - 1
+        return max(0, min(int(self.engine.spec_cfg.max_draft), cap))
+
+    def _emit_token(self, req: ServeRequest, nxt: int) -> bool:
+        """Record one generated token; returns True if the request reached a
+        terminal state (eos / length)."""
         req.generated.append(nxt)
         if self._trace:
             now = self.clock()
@@ -299,11 +360,36 @@ class ContinuousBatcher:
         if self.cfg.eos_token_id is not None \
                 and nxt == self.cfg.eos_token_id:
             self.manager.complete(req, "eos")
-            return
+            return True
         if len(req.generated) >= req.max_new_tokens:
             self.manager.complete(req, "length")
-            return
+            return True
         req.next_token = nxt
+        return False
+
+    def _advance(self, req: ServeRequest, fed: int, logits) -> None:
+        """Commit one put()'s outcome for one request. The argmax of this
+        step's logits IS a generated token, counted and completion-checked
+        immediately — a request's last token never rides an extra decode
+        step (whose logits would be discarded) just to be recorded."""
+        if req.state == PREFILLING:
+            req.prefilled += fed
+            self.counters["prefill_tokens"] += fed
+            if req.prefilled < req.prompt_len:
+                return
+            req.state = DECODING
+        else:
+            self.counters["decode_tokens"] += 1
+        self._emit_token(req, int(np.argmax(np.asarray(logits))))
+
+    def _advance_spec(self, req: ServeRequest, emitted) -> None:
+        """Commit a spec round's emitted tokens (1..K+1). An eos inside the
+        accepted run truncates there; the extra KV the verify step committed
+        is reclaimed by the terminal flush like any other over-allocation."""
+        for tok in emitted:
+            self.counters["decode_tokens"] += 1
+            if self._emit_token(req, int(tok)):
+                return
 
     def step(self) -> bool:
         """One serving iteration; returns True if an engine step ran."""
@@ -322,8 +408,27 @@ class ContinuousBatcher:
                 self.drained = True
             return False
         chunk = self.cfg.prefill_chunk
+        # with speculation on, DECODING requests WITH a draft leave the
+        # put() batch for a draft-verify round (multiple tokens per step);
+        # draft-less decodes and prefill chunks keep riding the one packed
+        # put() — no second dispatch unless there is something to verify
+        spec_on = self._spec_enabled()
+        spec_batch, spec_drafts = [], []
+        if spec_on:
+            decoding = [r for r in batch if r.state == DECODING]
+            if decoding:
+                drafts = self.engine.draft_tokens(
+                    [r.uid for r in decoding],
+                    [r.next_token for r in decoding],
+                    [self._spec_cap(r) for r in decoding])
+                for r, d in zip(decoding, drafts):
+                    if len(d):
+                        spec_batch.append(r)
+                        spec_drafts.append(d)
+        spec_set = {r.uid for r in spec_batch}
+        put_batch = [r for r in batch if r.uid not in spec_set]
         uids, chunks = [], []
-        for r in batch:
+        for r in put_batch:
             uids.append(r.uid)
             chunks.append(np.asarray([r.next_token], np.int32)
                           if r.state == DECODING
@@ -333,7 +438,7 @@ class ContinuousBatcher:
             inj.on_serving_step(
                 "decode" if any(r.state == DECODING for r in batch)
                 else "prefill")
-            results = self.engine.put(uids, chunks)
+            results = self.engine.put(uids, chunks) if put_batch else {}
         except CapacityError as e:
             # backstop only — _plan() pre-checks joint schedulability; a race
             # (or an engine-internal reject) sheds one victim and yields
@@ -345,7 +450,7 @@ class ContinuousBatcher:
             # every request keeps its position and retries next step
             failed = f"io: {e}"
         if failed is None:
-            for r, c in zip(batch, chunks):
+            for r, c in zip(put_batch, chunks):
                 logits = inj.maybe_poison_logits(results[r.uid]) if inj \
                     else results[r.uid]
                 if not np.all(np.isfinite(np.asarray(logits, np.float32))):
@@ -355,6 +460,38 @@ class ContinuousBatcher:
                     failed = f"non-finite logits uid={r.uid}"
                     continue
                 self._advance(r, len(c), logits)
+        if failed is None and spec_batch:
+            # the put() above already committed — run the spec round second
+            # so a failure here never strands put()'s advanced requests
+            try:
+                res, info = self.engine.spec_decode_round(
+                    [r.uid for r in spec_batch],
+                    [r.next_token for r in spec_batch],
+                    drafts=spec_drafts)
+            except CapacityError as e:
+                victim = max(spec_batch,
+                             key=lambda r: (-r.priority, r.submitted_at))
+                self.manager.shed(victim, "capacity")
+                failed = f"capacity: {e}"
+            except (InjectedIOError, OSError) as e:
+                failed = f"io: {e}"   # round uncommitted; retried next step
+            else:
+                self.counters["spec_rounds"] += 1
+                self.counters["spec_draft_tokens"] += info["drafted"]
+                self.counters["spec_accepted_tokens"] += info["accepted"]
+                self.metrics.record_spec_round(info["drafted"],
+                                               info["accepted"])
+                bad = set(info.get("nonfinite_uids", ()))
+                for r in spec_batch:
+                    if r.uid in bad:
+                        # mirror of the put() non-finite guard: the verify
+                        # forward committed KV, so there is no clean retry
+                        # point — resolve loudly instead of streaming an
+                        # argmax-of-NaN token
+                        self.manager.shed(r, "decode_failure")
+                        failed = f"non-finite logits uid={r.uid}"
+                        continue
+                    self._advance_spec(r, res[r.uid])
         self.steps += 1
         self.counters["engine_steps"] += 1
         self.metrics.step_ms.observe((self.clock() - t0) * 1e3)
@@ -547,6 +684,9 @@ class ContinuousBatcher:
                             ("tpot", self.metrics.tpot_ms),
                             ("queue_wait", self.metrics.queue_wait_ms))
         }
+        pc = getattr(self.engine, "prefix_cache", None)
+        spec = (dict(self.engine.spec_stats)
+                if self._spec_enabled() else None)
         return {
             "health": self.health,
             "drained": self.drained,
@@ -561,7 +701,11 @@ class ContinuousBatcher:
             "kv": {"num_blocks": self.num_blocks,
                    "used_blocks": self.used_blocks,
                    "free_blocks": self.num_blocks - self.used_blocks,
+                   "cache_blocks": self.cache_blocks,
+                   "reclaimable_blocks": self.reclaimable_blocks,
                    "occupancy": round(self.kv_occupancy, 4)},
+            "prefix_cache": pc.report() if pc is not None else None,
+            "speculative": spec,
             "latency_ms": {"p50": round(self._latency_pct(50), 3),
                            "p99": round(self._latency_pct(99), 3),
                            "samples": self._step_window.count},

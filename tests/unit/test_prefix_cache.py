@@ -1,0 +1,550 @@
+"""Prefix-cache KV reuse + n-gram speculative decoding tests.
+
+Cache-exactness is the contract under test: the same prompt served cold vs
+prefix-cached, and greedy decode with speculation on vs off, must produce
+IDENTICAL tokens — sharing/drafting may only change how much work it takes
+to produce them. Exactness tests run the tiny model in float32: in bf16 a
+random-init model's near-tied logits can flip argmax between the (all
+numerically-equivalent) attention kernel variants, which is a test-model
+artifact, not a property of the mechanism (a trained model's logit margins
+dwarf kernel rounding).
+
+Also here: the refcounted-allocator satellite (double-free raises), the
+duplicate-uid ``can_schedule_batch`` satellite, LRU eviction under pool
+pressure, and refcount-leak-free pool restoration. The end-to-end
+``prefix-storm`` drill lives in ``tools/serve_drill.py``; its slow wrapper
+is at the bottom under the ``perf`` marker.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (BlockedAllocator, InferenceEngineV2,
+                                     PrefixCache, SequenceManager,
+                                     ngram_draft)
+from deepspeed_tpu.models import TransformerLM, get_preset
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+
+
+# ---------------------------------------------------------------------------
+# refcounted allocator (satellite: double-free must raise)
+# ---------------------------------------------------------------------------
+
+class TestRefcountedAllocator:
+    def test_double_free_raises(self):
+        alloc = BlockedAllocator(num_blocks=4, block_size=8)
+        a = alloc.allocate(2)
+        alloc.free(a)
+        assert alloc.free_blocks == 4
+        with pytest.raises(RuntimeError, match="double free"):
+            alloc.free(a)              # second free of the same blocks
+        # the failed free must not have corrupted the free list
+        assert alloc.free_blocks == 4
+        with pytest.raises(RuntimeError, match="double free"):
+            alloc.free([0])            # never-reallocated block
+
+    def test_shared_block_needs_one_free_per_owner(self):
+        alloc = BlockedAllocator(num_blocks=2, block_size=8)
+        [b] = alloc.allocate(1)
+        alloc.incref([b])              # second owner (e.g. the prefix tree)
+        alloc.free([b])                # first owner releases
+        assert alloc.free_blocks == 1  # still held by the second owner
+        assert alloc.refcount(b) == 1
+        alloc.free([b])
+        assert alloc.free_blocks == 2
+        with pytest.raises(RuntimeError, match="double free"):
+            alloc.free([b])
+
+    def test_incref_of_free_block_raises(self):
+        alloc = BlockedAllocator(num_blocks=2, block_size=8)
+        with pytest.raises(RuntimeError, match="unallocated"):
+            alloc.incref([0])
+
+
+# ---------------------------------------------------------------------------
+# duplicate-uid joint schedulability (satellite)
+# ---------------------------------------------------------------------------
+
+class TestDuplicateUidBatch:
+    def test_duplicate_uid_blocks_costed_cumulatively(self):
+        """A uid listed twice must be costed against its PROJECTED state
+        after the first occurrence — the old per-occurrence check read the
+        original ``seen_tokens`` twice and undercounted block demand."""
+        sm = SequenceManager(max_sequences=2, max_seq_len=64, block_size=8,
+                             num_blocks=8)
+        sm.schedule(1, 4)
+        sm.commit(1)                   # seen=4, holds 1 block (4/8 used)
+        taken = sm.allocator.allocate(sm.allocator.free_blocks)  # drain pool
+        # two 4-token chunks: cumulative 4+8=12 tokens -> needs a 2nd block;
+        # per-occurrence math said ceil(8/8)-1 = 0 twice -> "schedulable"
+        assert not sm.can_schedule_batch([1, 1], [4, 4])
+        sm.allocator.free(taken)
+        assert sm.can_schedule_batch([1, 1], [4, 4])
+
+    def test_duplicate_uid_seq_len_costed_cumulatively(self):
+        sm = SequenceManager(max_sequences=2, max_seq_len=32, block_size=8)
+        sm.schedule(1, 30)
+        sm.commit(1)
+        # each occurrence alone fits (30+2 <= 32); jointly 34 > 32
+        assert sm.can_schedule_batch([1], [2])
+        assert not sm.can_schedule_batch([1, 1], [2, 2])
+
+    def test_duplicate_new_uid_counts_one_slot(self):
+        sm = SequenceManager(max_sequences=1, max_seq_len=32, block_size=8)
+        assert sm.can_schedule_batch([7, 7], [4, 4])   # one slot, not two
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache state machine (no engine)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheState:
+    def _cache(self, num_blocks=8, bs=4, **kw):
+        alloc = BlockedAllocator(num_blocks, bs)
+        return alloc, PrefixCache(alloc, **kw)
+
+    def test_full_block_granularity_and_roundtrip(self):
+        alloc, pc = self._cache()
+        toks = np.arange(10, dtype=np.int32)          # 2 full blocks + tail 2
+        blocks = alloc.allocate(3)
+        assert pc.insert(toks, blocks) == 2           # tail block not cached
+        got, n = pc.peek(toks)
+        assert n == 8 and got == blocks[:2]
+        # a diverging second block matches only the first
+        other = np.concatenate([toks[:4], toks[4:8] + 1])
+        _, n2 = pc.peek(other)
+        assert n2 == 4
+        # acquire takes a reference per matched block
+        acq, n3 = pc.acquire(toks)
+        assert n3 == 8
+        assert alloc.refcount(blocks[0]) == 3         # owner + tree + acquire
+        assert pc.counters["hits"] == 1 and pc.counters["hit_tokens"] == 8
+
+    def test_max_tokens_caps_at_full_blocks(self):
+        alloc, pc = self._cache()
+        toks = np.arange(8, dtype=np.int32)
+        pc.insert(toks, alloc.allocate(2))
+        # cap 7 (len-1): only 1 full block may match — the tail block is
+        # recomputed, never shared (copy-on-write by recompute)
+        _, n = pc.peek(toks, max_tokens=7)
+        assert n == 4
+
+    def test_lru_eviction_spares_referenced_blocks(self):
+        alloc, pc = self._cache(num_blocks=4, bs=4)
+        a = alloc.allocate(1)
+        b = alloc.allocate(1)
+        pc.insert(np.arange(4), a)
+        pc.insert(np.arange(100, 104), b)
+        alloc.free(a)                  # tree is now block a's only owner
+        alloc.free(b)
+        pc.acquire(np.arange(100, 104))   # pin b via a live reference, bump LRU
+        assert pc.evictable_blocks() == 1
+        assert pc.evict(2) == 1        # only a can go; b is pinned
+        assert pc.peek(np.arange(4))[1] == 0
+        assert pc.peek(np.arange(100, 104))[1] == 4
+
+    def test_lru_order(self):
+        alloc, pc = self._cache(num_blocks=4, bs=4)
+        a, b = alloc.allocate(1), alloc.allocate(1)
+        pc.insert(np.arange(4), a)
+        pc.insert(np.arange(100, 104), b)
+        alloc.free(a)
+        alloc.free(b)
+        got, _ = pc.acquire(np.arange(4))   # refresh a: b is now LRU
+        alloc.free(got)
+        assert pc.evict(1) == 1
+        assert pc.peek(np.arange(4))[1] == 4          # a survived
+        assert pc.peek(np.arange(100, 104))[1] == 0   # b evicted
+
+    def test_interior_nodes_evict_only_after_leaves(self):
+        alloc, pc = self._cache(num_blocks=4, bs=4)
+        blocks = alloc.allocate(2)
+        pc.insert(np.arange(8), blocks)    # chain: parent -> child
+        alloc.free(blocks)
+        assert pc.evict(1) == 1            # must take the LEAF (child)
+        assert pc.peek(np.arange(8))[1] == 4   # parent still matches
+        assert pc.evict(1) == 1
+        assert alloc.free_blocks == 4
+
+    def test_max_blocks_cap(self):
+        alloc, pc = self._cache(num_blocks=8, bs=4, max_blocks=2)
+        a = alloc.allocate(3)
+        pc.insert(np.arange(12), a)
+        assert pc._nodes == 2              # third block refused at the cap
+        alloc.free(a)                      # tree keeps refs on the first two
+        b = alloc.allocate(1)
+        pc.insert(np.arange(100, 104), b)  # evicts LRU to stay at cap
+        assert pc._nodes == 2
+        assert pc.counters["evicted_blocks"] == 1
+
+    def test_max_blocks_insert_never_orphans_descent_path(self):
+        """At the cap, insert must NOT evict a node on the prefix it is
+        descending — the new node would attach to a detached parent, an
+        unreachable subtree whose cache references could never be released
+        (review regression)."""
+        alloc, pc = self._cache(num_blocks=8, bs=4, max_blocks=1)
+        a = alloc.allocate(1)
+        pc.insert(np.arange(4), a)         # node A fills the cap
+        alloc.free(a)                      # A rc1: the sole evictable leaf
+        b = alloc.allocate(2)
+        pc.insert(np.arange(8), b)         # descends THROUGH A at the cap
+        alloc.free(b)
+        pc.clear()
+        assert alloc.free_blocks == 8
+        assert not alloc.leaked_blocks()
+
+    def test_clear_releases_only_tree_refs(self):
+        alloc, pc = self._cache(num_blocks=4, bs=4)
+        a = alloc.allocate(1)
+        pc.insert(np.arange(4), a)
+        assert pc.clear() == 1
+        assert alloc.refcount(a[0]) == 1   # the live owner's ref remains
+        alloc.free(a)
+        assert alloc.free_blocks == 4 and not alloc.leaked_blocks()
+
+
+# ---------------------------------------------------------------------------
+# n-gram drafter
+# ---------------------------------------------------------------------------
+
+class TestNgramDraft:
+    def test_draft_follows_most_recent_occurrence(self):
+        h = [1, 2, 3, 9, 1, 2, 4, 7, 1, 2]
+        d = list(ngram_draft(h, ngram=2, max_draft=3))
+        assert d == [4, 7, 1]              # continuation of the LATEST [1,2]
+
+    def test_backoff_to_shorter_ngram(self):
+        h = [5, 6, 7, 8, 6]                # [8, 6] never repeats; [6] does
+        assert list(ngram_draft(h, ngram=2, max_draft=2)) == [7, 8]
+
+    def test_no_repeat_no_draft(self):
+        assert ngram_draft([1, 2, 3, 4], ngram=3, max_draft=4).size == 0
+        assert ngram_draft([1], ngram=3, max_draft=4).size == 0
+        assert ngram_draft([1, 1], ngram=2, max_draft=0).size == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration (fp32 tiny model: exactness without bf16 tie noise;
+# module-scoped SHARED engines — every fresh InferenceEngineV2 re-jits its
+# whole step family, so tests reuse engines and reset state between them)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def f32_lm():
+    model = TransformerLM(get_preset("tiny", dtype="float32"))
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+_SPEC = {"enabled": True, "ngram": 2, "max_draft": 4, "fallback_steps": 4}
+
+
+def _engine(model, params, **kw):
+    base = dict(max_sequences=8, max_seq_len=128, block_size=16)
+    base.update(kw)
+    return InferenceEngineV2(model, params=params, **base)
+
+
+def _reset(eng):
+    """Back to a cold engine: flush every sequence, drop the prefix tree,
+    zero the feature counters (they are lifetime-cumulative)."""
+    eng.flush(list(eng.state.sequences))
+    if eng.prefix_cache is not None:
+        eng.prefix_cache.clear()
+        for k in eng.prefix_cache.counters:
+            eng.prefix_cache.counters[k] = 0
+    for k in eng.spec_stats:
+        eng.spec_stats[k] = 0
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks, "leak from previous test"
+    return eng
+
+
+@pytest.fixture(scope="module")
+def feat_eng(f32_lm):
+    model, params = f32_lm
+    return _engine(model, params, prefix_cache=True, speculative=_SPEC)
+
+
+@pytest.fixture(scope="module")
+def plain_eng(f32_lm):
+    model, params = f32_lm
+    return _engine(model, params)
+
+
+@pytest.fixture(scope="module")
+def small_eng(f32_lm):
+    """Small pool for eviction-pressure tests."""
+    model, params = f32_lm
+    return _engine(model, params, prefix_cache=True, num_blocks=12,
+                   max_seq_len=64)
+
+
+def test_warm_prefix_cache_is_token_identical(feat_eng):
+    """Same prompt cold vs prefix-cached: identical first token and
+    identical greedy continuation, with the warm put skipping the cached
+    full blocks (cache-exactness satellite)."""
+    eng = _reset(feat_eng)
+    rng = np.random.default_rng(0)
+    prompt = np.concatenate([rng.integers(0, 250, 48),   # 3 full blocks
+                             rng.integers(0, 250, 5)])
+    r1 = eng.put([1], [prompt])
+    t1 = int(np.argmax(r1[1]))
+    cold = [int(x) for x in
+            eng.decode_batch([1], [t1], steps=8, speculative=False)[1]]
+    eng.flush([1])
+    r2 = eng.put([2], [prompt])
+    t2 = int(np.argmax(r2[2]))
+    assert eng.prefix_cache.counters["hit_tokens"] == 48
+    assert eng.state.sequences[2].seen_tokens == len(prompt)
+    warm = [int(x) for x in
+            eng.decode_batch([2], [t2], steps=8, speculative=False)[2]]
+    assert t1 == t2 and cold == warm
+    # shared blocks really are shared: the warm sequence holds the cached
+    # prefix blocks at refcount >= 2 (sequence + tree)
+    seq = eng.state.sequences[2]
+    assert all(eng.state.allocator.refcount(b) >= 2 for b in seq.blocks[:3])
+    eng.flush([2])
+    assert eng.prefix_cache.clear() > 0
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+
+
+def test_partial_prefix_match_prefills_only_suffix(feat_eng, plain_eng):
+    eng = _reset(feat_eng)
+    rng = np.random.default_rng(1)
+    shared = rng.integers(0, 250, 32)                    # 2 full blocks
+    p_a = np.concatenate([shared, rng.integers(0, 250, 20)])
+    p_b = np.concatenate([shared, rng.integers(0, 250, 24)])
+    ra = eng.put([1], [p_a])
+    rb = eng.put([2], [p_b])                             # shares 32 tokens
+    assert eng.prefix_cache.counters["hit_tokens"] == 32
+    # exactness of the shared-prefix serve vs a cold engine
+    cold = _reset(plain_eng)
+    ca = cold.put([1], [p_a])
+    cb = cold.put([2], [p_b])
+    cold.flush([1, 2])
+    assert int(np.argmax(ra[1])) == int(np.argmax(ca[1]))
+    assert int(np.argmax(rb[2])) == int(np.argmax(cb[2]))
+
+
+def test_fully_cached_prompt_still_computes_last_token(feat_eng):
+    """A prompt that is one long cached prefix (length a block multiple)
+    must cap the match below the prompt length so the forward still runs
+    and yields first-token logits."""
+    eng = _reset(feat_eng)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 250, 64)                    # exactly 4 blocks
+    r1 = eng.put([1], [prompt])
+    eng.flush([1])
+    r2 = eng.put([2], [prompt])                          # 100% published
+    # matched capped at 48 (< 64): the tail block is recomputed
+    assert eng.state.sequences[2].seen_tokens == 64
+    assert eng.prefix_cache.counters["hit_tokens"] == 48
+    assert int(np.argmax(r1[1])) == int(np.argmax(r2[2]))
+
+
+def test_speculative_greedy_token_identical(feat_eng):
+    """Greedy decode with speculation on vs off is token-identical — on
+    repetitive text (where n-gram drafting fires) AND on random text (where
+    rounds mostly fall back). Satellite: >1 token emitted per verify round
+    on repetitive text."""
+    eng = _reset(feat_eng)
+    for seed, prompt in ((3, np.tile([5, 6, 7, 8], 8)),
+                         (4, np.random.default_rng(4).integers(0, 250, 30))):
+        r = eng.put([1], [np.asarray(prompt)])
+        t = int(np.argmax(r[1]))
+        ref = [int(x) for x in
+               eng.decode_batch([1], [t], steps=20, speculative=False)[1]]
+        eng.flush([1])
+        eng.put([2], [np.asarray(prompt)])
+        got = [int(x) for x in
+               eng.decode_batch([2], [t], steps=20, speculative=True)[2]]
+        assert got == ref, (seed, got, ref)
+        eng.flush([2])
+    assert eng.spec_stats["rounds"] > 0
+    # acceptance win on the repetitive prompt, measured in isolation
+    _reset(eng)
+    eng.put([1], [np.tile([5, 6, 7, 8], 8)])
+    eng.decode_batch([1], [1], steps=24)
+    s2 = eng.spec_stats
+    assert s2["emitted"] / max(1, s2["rounds"]) > 1.0, s2
+
+
+def test_spec_partial_accept_leaves_consistent_state(feat_eng, plain_eng):
+    """After rounds with rejected drafts (stale KV beyond the frontier),
+    continued decode must still match the non-speculative stream — the
+    frontier math masks and later overwrites the stale rows."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 250, 20)
+    eng = _reset(feat_eng)
+    eng.put([1], [prompt])
+    a = [int(x) for x in eng.decode_batch([1], [3], steps=10)[1]]
+    b = [int(x) for x in eng.decode_batch([1], [a[-1]], steps=10)[1]]
+    ref_eng = _reset(plain_eng)
+    ref_eng.put([1], [prompt])
+    ra = [int(x) for x in ref_eng.decode_batch([1], [3], steps=10)[1]]
+    rb = [int(x) for x in ref_eng.decode_batch([1], [ra[-1]], steps=10)[1]]
+    assert a == ra and b == rb
+    assert eng.state.sequences[1].seen_tokens \
+        == ref_eng.state.sequences[1].seen_tokens
+
+
+def test_prefix_eviction_under_pool_pressure(small_eng):
+    """Distinct published prefixes overflow a small pool: scheduling must
+    reclaim LRU cache blocks instead of failing, and the pool must restore
+    fully afterwards (no refcount leak)."""
+    eng = _reset(small_eng)
+    rng = np.random.default_rng(6)
+    for uid in range(8):                       # 8 x 2 published blocks > 12
+        eng.put([uid], [rng.integers(0, 250, 40)])
+        eng.flush([uid])
+    assert eng.prefix_cache.counters["evicted_blocks"] > 0
+    assert len(eng.state.sequences) == 0
+    eng.prefix_cache.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+
+
+def test_shared_blocks_never_evicted_or_double_freed(small_eng):
+    """A block a live sequence shares (refcount > 1) must survive cache
+    eviction pressure; flushing both owners releases it exactly once."""
+    eng = _reset(small_eng)
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 250, 32)          # 2 blocks published
+    eng.put([1], [np.concatenate([shared, rng.integers(0, 250, 4)])])
+    eng.put([2], [np.concatenate([shared, rng.integers(0, 250, 4)])])
+    pinned = eng.state.sequences[2].blocks[:2]
+    assert all(eng.state.allocator.refcount(b) >= 3 for b in pinned)
+    assert eng.prefix_cache.evict(12) == 0     # everything is pinned
+    eng.flush([1, 2])
+    eng.prefix_cache.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+
+
+def test_put_reject_is_side_effect_free_with_warm_cache(small_eng):
+    """A fresh-uid put() that raises CapacityError must leave NO state —
+    no slot, no cache refs, no seen_tokens — even when the prompt has a
+    warm cached prefix, so the caller can free capacity and retry the
+    SAME call (review regression: auto-attach used to run before the
+    capacity check)."""
+    from deepspeed_tpu.inference import CapacityError
+
+    eng = _reset(small_eng)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 250, 40)          # 3 blocks, 2 published
+    r1 = eng.put([1], [prompt])
+    hog = eng.state.allocator.allocate(eng.state.allocator.free_blocks)
+    with pytest.raises(CapacityError):
+        eng.put([2], [prompt])                 # warm prefix, no room
+    assert 2 not in eng.state.sequences        # no slot consumed
+    assert eng._hist is not None and 2 not in eng._hist
+    eng.state.allocator.free(hog)
+    r2 = eng.put([2], [prompt])                # retry: attaches + succeeds
+    assert eng.state.sequences[2].seen_tokens == 40
+    assert eng.prefix_cache.counters["hit_tokens"] == 32
+    assert int(np.argmax(r2[2])) == int(np.argmax(r1[1]))
+    eng.flush([1, 2])
+
+
+def test_config_blocks_reach_engine(f32_lm):
+    from deepspeed_tpu.config import DeepSpeedTpuConfig
+
+    cfg = DeepSpeedTpuConfig(train_batch_size=8, inference={
+        "prefix_cache": {"enabled": True, "max_blocks": 32},
+        "speculative": {"enabled": True, "ngram": 4, "max_draft": 6}})
+    assert cfg.inference.prefix_cache.max_blocks == 32
+    assert cfg.inference.speculative.max_draft == 6
+    model, params = f32_lm
+    eng = InferenceEngineV2(model, params=params, max_sequences=2,
+                            max_seq_len=64, block_size=16,
+                            prefix_cache=cfg.inference.prefix_cache,
+                            speculative=cfg.inference.speculative)
+    assert eng.prefix_cache is not None and eng.prefix_cache.max_blocks == 32
+    assert eng.spec_cfg.max_draft == 6
+    with pytest.raises(ValueError, match="max_draft"):
+        DeepSpeedTpuConfig(train_batch_size=8, inference={
+            "speculative": {"enabled": True, "max_draft": 0}})
+    # both features need the packed paged engine
+    with pytest.raises(ValueError, match="packed"):
+        InferenceEngineV2(model, params=params, max_sequences=2,
+                          max_seq_len=64, prefix_cache=True, paged=False)
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+def test_serving_prefix_spec_exact_and_metered(feat_eng, plain_eng):
+    """The batcher with prefix cache + speculation serves the same token
+    streams as the plain batcher, and the ``serving/spec_*`` +
+    ``inference/prefix_cache_*`` metrics populate."""
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.observability import MetricsRegistry
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    rng = np.random.default_rng(8)
+    system = rng.integers(0, 250, 48)
+    prompts = [np.concatenate([system, rng.integers(0, 250, 6)])
+               for _ in range(3)]
+
+    def run(eng, registry=None):
+        b = ContinuousBatcher(
+            eng, ServingConfig(prefill_chunk=32, default_max_new_tokens=6),
+            registry=registry)
+        outs = []
+        for p in prompts:              # sequential: later ones hit the cache
+            uid = b.submit(p)
+            b.pump(max_steps=100)
+            outs.append(list(b.manager.done[uid].generated))
+        return b, outs
+
+    _, base = run(_reset(plain_eng))
+    reg = MetricsRegistry()
+    b, got = run(_reset(feat_eng), registry=reg)
+    assert got == base
+    rep = b.serving_report()
+    assert rep["counters"]["prefix_hit_requests"] == 2
+    assert rep["counters"]["prefix_hit_tokens"] == 96
+    assert rep["counters"]["spec_rounds"] > 0
+    assert rep["prefix_cache"]["hit_tokens"] == 96
+    assert rep["speculative"]["rounds"] > 0
+    assert reg.get("serving/spec_rounds") is not None
+    # prefix-aware admission: a mostly-cached request's projected demand
+    # counts only the uncached share
+    req = type("R", (), {})()
+    req.prompt = prompts[0]
+    req.prompt_len = len(prompts[0])
+    req.total_token_demand = len(prompts[0]) + 6
+    assert b._blocks_needed(req) < b._blocks_for(req.total_token_demand)
+    # cache-held blocks are reclaimable capacity, not load
+    assert rep["kv"]["reclaimable_blocks"] > 0
+    assert rep["kv"]["occupancy"] == 0.0
+    b.engine.prefix_cache.clear()
+    alloc = b.engine.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# drill wrapper (slow; the CLI is the invariant authority)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_prefix_storm_drill(tmp_path):
+    import sys
+
+    sys.path.insert(0, _TOOLS)
+    from serve_drill import run_scenario
+
+    verdict = run_scenario("prefix-storm", workdir=str(tmp_path))
+    assert verdict["ok"], verdict

@@ -271,6 +271,51 @@ def test_per_priority_queue_depth_gauges(tiny_engine):
     assert series["0"] == 0.0 and series["7"] == 0.0
 
 
+def test_prefix_aware_admission_admits_mostly_cached_request():
+    """Prefix-aware admission: with a warm cache, a request whose prompt is
+    ~85% resident counts only its uncached share against the KV budget —
+    it admits immediately while an equal-size COLD request must wait for
+    in-flight work to finish. Cache-held blocks never count as load."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    eng = InferenceEngineV2(TransformerLM(get_preset("tiny")),
+                            max_sequences=8, max_seq_len=128, block_size=16,
+                            prefix_cache=True)
+    # warm the cache: 96-token prompt -> 6 published blocks (80 attachable
+    # under the len-1 cap)
+    shared = np.arange(96) % 250
+    eng.put([900], [shared])
+    eng.flush([900])
+    # budget = 0.3 * 64 = 19.2 blocks. Cold demand ceil((96+8)/16) = 7;
+    # warm demand = ceil((96-80+8)/16) = 2 NEW blocks (its 5 attached
+    # blocks count once, as pinned pool use, after it admits: A(7) +
+    # warm(5+2) = 14 projected -> +7 cold would cross the budget, +2 warm
+    # does not; peak occupancy 14/64 stays under the pressure watermark)
+    cfg = ServingConfig(prefill_chunk=32, default_max_new_tokens=8,
+                        kv_high_watermark=0.30, kv_low_watermark=0.20)
+    b = ContinuousBatcher(eng, cfg)
+    # cache-held blocks are reclaimable capacity, not occupancy
+    assert b.reclaimable_blocks == 6 and b.kv_occupancy == 0.0
+    a = b.submit((np.arange(96) + 7) % 250)    # cold A: 7 of 9.6 blocks
+    b.step()
+    assert b.manager.resolve(a) in ("prefilling", "decoding")
+    warm = b.submit(shared)                    # 2 more blocks: fits
+    cold = b.submit((np.arange(96) + 31) % 250)  # 7 more: must wait
+    b.step()
+    assert b.manager.resolve(warm) in ("prefilling", "decoding")
+    assert b.manager.resolve(cold) == QUEUED
+    assert b.counters["prefix_hit_requests"] == 1
+    assert b.counters["prefix_hit_tokens"] == 80
+    b.pump(max_steps=200)                      # blocks free -> cold admits
+    for uid in (a, warm, cold):
+        assert b.manager.resolve(uid) == COMPLETED
+    assert b.manager.counters["shed"] == 0
+    eng.prefix_cache.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+
+
 # ---------------------------------------------------------------------------
 # drill wrappers (slow; the CLI is the invariant authority)
 # ---------------------------------------------------------------------------

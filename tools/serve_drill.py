@@ -18,6 +18,7 @@ Invariants asserted after EVERY drill:
     python tools/serve_drill.py --scenario shed-under-kv-pressure
     python tools/serve_drill.py --scenario sigterm-drain
     python tools/serve_drill.py --scenario frontend-storm
+    python tools/serve_drill.py --scenario prefix-storm
 
 Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
 Slow pytest wrappers live in ``tests/unit/test_serving.py`` under the
@@ -39,15 +40,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _make_batcher(num_blocks=None, monitor=None, clock=time.monotonic,
-                  **serving):
+                  engine_kw=None, **serving):
     from deepspeed_tpu.config.config import ServingConfig
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.models import TransformerLM, get_preset
     from deepspeed_tpu.serving import ContinuousBatcher
 
-    eng = InferenceEngineV2(TransformerLM(get_preset("tiny")),
-                            max_sequences=8, max_seq_len=128, block_size=16,
-                            num_blocks=num_blocks)
+    ekw = {"max_sequences": 8, "max_seq_len": 128, "block_size": 16,
+           "num_blocks": num_blocks, **(engine_kw or {})}
+    preset = ekw.pop("preset_kw", {})
+    eng = InferenceEngineV2(TransformerLM(get_preset("tiny", **preset)),
+                            **ekw)
     cfg = ServingConfig(**{"prefill_chunk": 32, "default_max_new_tokens": 8,
                            **serving})
     return ContinuousBatcher(eng, cfg, monitor=monitor, clock=clock)
@@ -366,11 +369,81 @@ def scenario_frontend_storm(workdir):
     return ok, details
 
 
+def scenario_prefix_storm(workdir):
+    """N clients share one system prompt (prefix cache + n-gram speculation
+    on, fp32 so exactness is argmax-stable). Invariants: cache hit-rate > 0
+    with every warm request attaching the shared blocks; token streams
+    IDENTICAL to a cache-less baseline; distinct-prefix churn forces LRU
+    eviction without evicting any block a live sequence shares; after
+    flush + cache clear the pool is fully restored with zero refcounts
+    leaked."""
+    import numpy as np
+
+    spec = {"enabled": True, "ngram": 2, "max_draft": 4, "fallback_steps": 4}
+    pkw = {"preset_kw": {"dtype": "float32"}}
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, 250, 48)          # 3 shared full blocks
+    prompts = [np.concatenate([system, rng.integers(0, 250, 6)])
+               for _ in range(8)]
+
+    def serve(b):
+        outs = []
+        for p in prompts:      # sequential: request 1 publishes, 2..N hit
+            uid = b.submit(p)
+            b.pump(max_steps=200)
+            outs.append([int(t) for t in b.manager.done[uid].generated])
+        return outs
+
+    base = serve(_make_batcher(engine_kw=pkw, default_max_new_tokens=10))
+    # pool sized so the distinct-prefix churn below overflows it: eviction
+    # must fire while the shared system blocks stay resident (hot LRU)
+    b = _make_batcher(num_blocks=40,
+                      engine_kw={**pkw, "prefix_cache": True,
+                                 "speculative": spec},
+                      default_max_new_tokens=10)
+    got = serve(b)
+    rep = b.serving_report()
+    pc = b.engine.prefix_cache
+
+    # churn distinct prefixes through the small pool to force LRU eviction
+    for i in range(12):
+        uid = b.submit(rng.integers(0, 250, 56))
+        b.pump(max_steps=200)
+    churn_rep = b.serving_report()
+
+    alloc = b.engine.state.allocator
+    live_after = len(b.engine.state.sequences)
+    cleared = pc.clear()
+    restored = alloc.free_blocks == alloc.num_blocks
+    leaked = alloc.leaked_blocks()
+    hit_rate = rep["counters"]["prefix_hit_requests"] / (len(prompts) - 1)
+    details = {
+        "tokens_identical": got == base,
+        "hit_requests": rep["counters"]["prefix_hit_requests"],
+        "hit_tokens": rep["counters"]["prefix_hit_tokens"],
+        "hit_rate": round(hit_rate, 3),
+        "speculative": rep["speculative"],
+        "evicted_blocks": churn_rep["prefix_cache"]["evicted_blocks"],
+        "cleared_blocks": cleared, "live_sequences": live_after,
+        "pool_restored": restored, "leaked_blocks": leaked,
+        "kv": churn_rep["kv"],
+    }
+    ok = (got == base
+          and hit_rate > 0
+          and rep["counters"]["prefix_hit_tokens"]
+          >= 48 * (len(prompts) - 1)
+          and rep["speculative"]["rounds"] > 0
+          and churn_rep["prefix_cache"]["evicted_blocks"] > 0
+          and live_after == 0 and restored and not leaked)
+    return ok, details
+
+
 SCENARIOS = {
     "deadline-storm": scenario_deadline_storm,
     "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
     "sigterm-drain": scenario_sigterm_drain,
     "frontend-storm": scenario_frontend_storm,
+    "prefix-storm": scenario_prefix_storm,
 }
 
 
