@@ -10,8 +10,17 @@
 //
 // C ABI for ctypes. A handle owns a queue + worker threads; ops complete in
 // submission order per worker but arbitrary order globally (same as reference).
+//
+// Two completion surfaces:
+//   * ds_aio_wait          — barrier over every submitted op (legacy).
+//   * ds_aio_submit_*      — returns an op id; ds_aio_wait_op blocks on ONE op,
+//                            so a writeback no longer fences the next prefetch
+//                            (the reference's per-handle completion queues).
+// ds_aio_stats exposes per-direction bytes and busy-window time (union of
+// in-flight intervals), so callers can report measured read/write bandwidth.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -22,9 +31,13 @@
 #include <string>
 #include <thread>
 #include <unistd.h>
+#include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
+
+using Clock = std::chrono::steady_clock;
 
 struct Op {
   enum Kind { READ, WRITE } kind;
@@ -33,6 +46,18 @@ struct Op {
   int64_t nbytes;
   int64_t file_offset;
   bool o_direct;
+  int64_t id;
+};
+
+// Per-direction transfer stats: bytes moved + busy-window time. The busy
+// window is the union of in-flight intervals (inflight 0->1 opens, ->0
+// closes), so overlapped ops are not double-counted and bytes/busy_ns is the
+// achieved device bandwidth, not the per-op average.
+struct DirStats {
+  int64_t bytes = 0;
+  int64_t busy_ns = 0;
+  int inflight = 0;
+  Clock::time_point open_t;
 };
 
 struct Handle {
@@ -44,6 +69,11 @@ struct Handle {
   std::atomic<int64_t> inflight{0};
   std::atomic<int64_t> errors{0};
   bool stop = false;
+  // per-op completion state (all under mu)
+  int64_t next_id = 1;
+  std::unordered_set<int64_t> live;        // submitted, not yet completed
+  std::unordered_map<int64_t, int> done;   // completed, not yet reaped
+  DirStats stats[2];                       // [READ, WRITE]
 
   void worker_loop() {
     for (;;) {
@@ -55,13 +85,23 @@ struct Handle {
         op = queue.front();
         queue.pop_front();
       }
-      if (run_op(op) != 0) errors.fetch_add(1);
-      if (inflight.fetch_sub(1) == 1) {
-        // lock (then release) mu before notifying so the wake can't fall in the
-        // gap between ds_aio_wait's predicate check and its sleep
-        { std::lock_guard<std::mutex> lk(mu); }
-        cv_done.notify_all();
+      int err = run_op(op);
+      if (err != 0) errors.fetch_add(1);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        live.erase(op.id);
+        done[op.id] = err;
+        DirStats& d = stats[op.kind];
+        if (err == 0) d.bytes += op.nbytes;
+        if (--d.inflight == 0)
+          d.busy_ns += std::chrono::duration_cast<std::chrono::nanoseconds>(
+                           Clock::now() - d.open_t).count();
+        // decrement under mu: ds_aio_wait's predicate reads inflight under
+        // mu, so a notify after this locked section can't fall in the gap
+        // between its predicate check and its sleep
+        inflight.fetch_sub(1);
       }
+      cv_done.notify_all();
     }
   }
 
@@ -89,6 +129,22 @@ struct Handle {
   }
 };
 
+int64_t submit(Handle* h, Op op) {
+  h->inflight.fetch_add(1);
+  int64_t id;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    id = h->next_id++;
+    op.id = id;
+    h->live.insert(id);
+    DirStats& d = h->stats[op.kind];
+    if (d.inflight++ == 0) d.open_t = Clock::now();
+    h->queue.push_back(std::move(op));
+  }
+  h->cv_submit.notify_one();
+  return id;
+}
+
 }  // namespace
 
 extern "C" {
@@ -112,40 +168,95 @@ void ds_aio_handle_destroy(void* handle) {
   delete h;
 }
 
-static void submit(Handle* h, Op op) {
-  h->inflight.fetch_add(1);
-  {
-    std::lock_guard<std::mutex> lk(h->mu);
-    h->queue.push_back(std::move(op));
-  }
-  h->cv_submit.notify_one();
+// Ticketed submission (per-op completion): returns the op id for
+// ds_aio_wait_op / ds_aio_poll_op. Buffer must stay alive until the op is
+// reaped (per-op wait, poll, or a full ds_aio_wait barrier).
+int64_t ds_aio_submit_pwrite(void* handle, const char* path, void* buf,
+                             int64_t nbytes, int64_t file_offset,
+                             int o_direct) {
+  return submit((Handle*)handle, Op{Op::WRITE, path, buf, nbytes, file_offset,
+                                    o_direct != 0, 0});
+}
+
+int64_t ds_aio_submit_pread(void* handle, const char* path, void* buf,
+                            int64_t nbytes, int64_t file_offset, int o_direct) {
+  return submit((Handle*)handle, Op{Op::READ, path, buf, nbytes, file_offset,
+                                    o_direct != 0, 0});
 }
 
 // async_pwrite (deepspeed_py_io_handle.cpp parity): buffer must stay alive
 // until ds_aio_wait returns 0 pending.
 void ds_aio_pwrite(void* handle, const char* path, void* buf, int64_t nbytes,
                    int64_t file_offset, int o_direct) {
-  submit((Handle*)handle, Op{Op::WRITE, path, buf, nbytes, file_offset,
-                             o_direct != 0});
+  ds_aio_submit_pwrite(handle, path, buf, nbytes, file_offset, o_direct);
 }
 
 void ds_aio_pread(void* handle, const char* path, void* buf, int64_t nbytes,
                   int64_t file_offset, int o_direct) {
-  submit((Handle*)handle, Op{Op::READ, path, buf, nbytes, file_offset,
-                             o_direct != 0});
+  ds_aio_submit_pread(handle, path, buf, nbytes, file_offset, o_direct);
+}
+
+// Block until op `id` completes. Returns 0 on success, -1 on IO error, 0 if
+// the id was already reaped (a ds_aio_wait barrier reaps everything). An
+// errored op reaped here is subtracted from the barrier's error count so one
+// failure is reported exactly once.
+int ds_aio_wait_op(void* handle, int64_t id) {
+  auto* h = (Handle*)handle;
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->cv_done.wait(lk, [&] { return h->done.count(id) || !h->live.count(id); });
+  auto it = h->done.find(id);
+  if (it == h->done.end()) return 0;  // reaped by a barrier wait
+  int err = it->second;
+  h->done.erase(it);
+  if (err != 0) h->errors.fetch_sub(1);
+  return err ? -1 : 0;
+}
+
+// Non-blocking completion probe: 1 = done ok (reaped), -1 = done with error
+// (reaped), 0 = still pending. Already-reaped ids report 1.
+int ds_aio_poll_op(void* handle, int64_t id) {
+  auto* h = (Handle*)handle;
+  std::lock_guard<std::mutex> lk(h->mu);
+  auto it = h->done.find(id);
+  if (it != h->done.end()) {
+    int err = it->second;
+    h->done.erase(it);
+    if (err != 0) h->errors.fetch_sub(1);
+    return err ? -1 : 1;
+  }
+  return h->live.count(id) ? 0 : 1;
 }
 
 // Block until every submitted op completes; returns the error count since the
-// last wait (reference handle.wait() semantics).
+// last wait (reference handle.wait() semantics). Reaps all per-op completion
+// records — a subsequent ds_aio_wait_op on an already-barriered id returns 0.
 int64_t ds_aio_wait(void* handle) {
   auto* h = (Handle*)handle;
   std::unique_lock<std::mutex> lk(h->mu);
   h->cv_done.wait(lk, [&] { return h->inflight.load() == 0; });
+  h->done.clear();
   return h->errors.exchange(0);
 }
 
 int64_t ds_aio_pending(void* handle) {
   return ((Handle*)handle)->inflight.load();
+}
+
+// out[0..3] = read_bytes, read_busy_ns, write_bytes, write_busy_ns.
+// Busy windows close only when the direction's inflight count hits zero, so
+// call after a wait/barrier for exact figures.
+void ds_aio_stats(void* handle, int64_t* out) {
+  auto* h = (Handle*)handle;
+  std::lock_guard<std::mutex> lk(h->mu);
+  for (int k = 0; k < 2; ++k) {
+    const DirStats& d = h->stats[k];
+    int64_t busy = d.busy_ns;
+    if (d.inflight > 0)
+      busy += std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  Clock::now() - d.open_t).count();
+    out[2 * k] = d.bytes;
+    out[2 * k + 1] = busy;
+  }
 }
 
 }  // extern "C"

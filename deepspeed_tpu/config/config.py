@@ -728,6 +728,50 @@ class ObservabilityConfig(DSTpuConfigModel):
         default_factory=ProfileTriggerConfig)
 
 
+class AioConfig(DSTpuConfigModel):
+    """``offload.aio`` — the swap pipeline's IO shape (reference: the
+    top-level ``aio`` block consumed by ``swap_tensor/``).
+
+    * ``threads`` — AIO worker threads per swapper (0 = auto: the autotuned
+      value when ``autotune`` is on, else the legacy
+      ``offload_optimizer.buffer_count``).
+    * ``chunk_mb`` — per-op IO size; larger tensors split into chunks
+      submitted across the whole threadpool (0 = auto: autotuned or 8 MB).
+    * ``prefetch_depth`` — depth k of the optimizer's read-ahead pipeline
+      (read leaf i+k while leaf i updates and leaf i-1 writes back);
+      0 = strictly serial.
+    * ``autotune`` — first use runs a short ``aio_bench`` sweep (cached per
+      swap-dir device) and adopts the best threads × chunk_mb.
+    * ``upload_overlap`` — device_put finished leaves while later leaves
+      are still in the host Adam (main-thread jax client preserved).
+    """
+
+    threads: int = 0
+    chunk_mb: int = 0
+    prefetch_depth: int = 2
+    autotune: bool = False
+    autotune_cache: str = ""       # "" = <tmpdir>/dstpu_aio_autotune.json
+    o_direct: bool = False
+    upload_overlap: bool = True
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.threads < 0 or self.chunk_mb < 0 or self.prefetch_depth < 0:
+            raise ValueError(
+                "offload.aio: threads/chunk_mb/prefetch_depth must be >= 0 "
+                "(0 means auto/serial)")
+        return self
+
+
+class OffloadConfig(DSTpuConfigModel):
+    """``offload`` — cross-cutting configuration of the host/NVMe offload
+    data path (which tier to offload lives under
+    ``zero_optimization.offload_param|offload_optimizer``; HOW the bytes
+    move lives here)."""
+
+    aio: AioConfig = Field(default_factory=AioConfig)
+
+
 class ResilienceConfig(DSTpuConfigModel):
     """``resilience`` section: the closed-loop fault-tolerance layer
     (``deepspeed_tpu/resilience``) — step guard, retries, checkpoint
@@ -774,6 +818,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     moe: MoEConfig = Field(default_factory=MoEConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    offload: OffloadConfig = Field(default_factory=OffloadConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)

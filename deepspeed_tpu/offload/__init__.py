@@ -7,6 +7,7 @@ swapping). The engine routes its optimizer step here when
 """
 
 from deepspeed_tpu.offload.cpu_adam import DeepSpeedCPUAdam  # noqa: F401
-from deepspeed_tpu.offload.swap import AsyncTensorSwapper  # noqa: F401
+from deepspeed_tpu.offload.swap import (  # noqa: F401
+    AsyncTensorSwapper, PinnedBufferPool, SwapTicket)
 from deepspeed_tpu.offload.optimizer import (  # noqa: F401
     HostOffloadOptimizer, ZenFlowSelectiveOptimizer)

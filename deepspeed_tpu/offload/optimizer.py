@@ -23,6 +23,9 @@ sequentially without oversubscription.
 from __future__ import annotations
 
 import os
+import queue as _queue
+import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -78,7 +81,10 @@ class HostOffloadOptimizer:
                  gradient_clipping: float = 0.0, schedule_fn=None,
                  nvme_path: Optional[str] = None, aio_threads: int = 2,
                  overlap_step: bool = False, shard_host_tier: bool = True,
-                 state_shardings: Any = None):
+                 state_shardings: Any = None, aio_chunk_mb: int = 0,
+                 prefetch_depth: int = 2, aio_autotune: bool = False,
+                 aio_o_direct: bool = False, aio_autotune_cache: str = "",
+                 upload_overlap: bool = True):
         self.adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
                                      weight_decay=weight_decay)
         self.schedule_fn = schedule_fn
@@ -88,8 +94,25 @@ class HostOffloadOptimizer:
         self._worker = ThreadPoolExecutor(max_workers=1) if overlap_step else None
         self._pending = None  # in-flight Future from step_async
         self._last_gnorm = float("nan")
+        # depth of the NVMe read-ahead pipeline (leaf i+k prefetches while
+        # leaf i updates); 0 = strictly serial (the bit-exactness oracle)
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        # overlap the H2D upload with the tail of the host Adam loop: the
+        # Adam runs on a dedicated worker (pure numpy/C++, GIL released in
+        # the native kernel) while THIS thread device_puts finished leaves —
+        # the jax client never leaves the caller's thread
+        self._upload_overlap = bool(upload_overlap) and not overlap_step
+        self._adam_pool: Optional[ThreadPoolExecutor] = None
+        self._adam_ms = 0.0
+        self._upload_ms = 0.0
+        self._stall_fraction = 0.0
+        self._obs_instruments = None
         self.swapper = (AsyncTensorSwapper(os.path.join(nvme_path, "opt_states"),
-                                           num_threads=aio_threads)
+                                           num_threads=aio_threads,
+                                           chunk_mb=aio_chunk_mb,
+                                           o_direct=aio_o_direct,
+                                           autotune=aio_autotune,
+                                           autotune_cache=aio_autotune_cache)
                         if nvme_path else None)
         # SHARDED host tier (reference stage_1_and_2 cpu_offload partitioning):
         # the fp32 masters/moments are stored per UNIQUE param shard — one
@@ -104,6 +127,7 @@ class HostOffloadOptimizer:
         self.m: Dict[str, np.ndarray] = {}
         self.v: Dict[str, np.ndarray] = {}
         self._sharded_tier = shard_host_tier
+        self._init_writes = deque()  # bounded in-flight init/load writebacks
         self._state_sh: Dict[str, Any] = {}
         state_map = (dict(_leaf_paths(state_shardings))
                      if state_shardings is not None else {})
@@ -154,6 +178,7 @@ class HostOffloadOptimizer:
                 self._init_shard(f"{name}#{i}", _host_copy(datas[key]))
         if self.swapper is not None:
             self.swapper.wait()
+            self._init_writes.clear()
         total = sum(a.size for a in self.master.values())
         n_shards = len(self.master)
         log_dist(f"host offload optimizer: {total/1e6:.1f}M fp32 master params "
@@ -165,8 +190,13 @@ class HostOffloadOptimizer:
         m = np.zeros_like(master)
         v = np.zeros_like(master)
         if self.swapper is not None:
-            self.swapper.swap_out(skey + ".m", m)
-            self.swapper.swap_out(skey + ".v", v)
+            self._init_writes.append(self.swapper.swap_out(skey + ".m", m))
+            self._init_writes.append(self.swapper.swap_out(skey + ".v", v))
+            # reap old init writes so the bulk zero-write never loans more
+            # than a window of pooled buffers (a multi-GB moment set would
+            # otherwise spike host RAM by its full size at init)
+            while len(self._init_writes) > 32:
+                self._init_writes.popleft().wait()
         else:
             self.m[skey], self.v[skey] = m, v
 
@@ -175,13 +205,30 @@ class HostOffloadOptimizer:
         """Update masters from device grads; returns (new device params, skipped).
 
         ``skipped=True`` (non-finite grad norm, fp16 overflow) leaves every state
-        untouched — the engine keeps its params and shrinks the loss scale."""
+        untouched — the engine keeps its params and shrinks the loss scale.
+
+        With ``upload_overlap`` the host Adam runs on a background worker
+        while this (main) thread ``device_put``s each leaf as soon as its
+        last shard finishes updating — the H2D upload hides under the tail
+        of the Adam loop instead of serializing after it."""
         host_grads, order = self._snapshot_grads(grads)
         gnorm = self._device_gnorm(grads)
-        skipped = self._host_work(host_grads, order, step_num, gnorm)
-        if skipped:
+        if not (self._upload_overlap and len(order) > 1
+                and np.isfinite(gnorm)):
+            skipped = self._host_work(host_grads, order, step_num, gnorm)
+            if skipped:
+                return params, True
+            return self._upload(params), False
+        if self._adam_pool is None:
+            self._adam_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="offload-adam")
+        done_q: "_queue.Queue[str]" = _queue.Queue()
+        fut = self._adam_pool.submit(self._host_work, host_grads, order,
+                                     step_num, gnorm, done_q.put)
+        new_params = self._upload_streamed(params, order, done_q, fut)
+        if fut.result():  # unreachable (gnorm pre-checked) — kept as a guard
             return params, True
-        return self._upload(params), False
+        return new_params, False
 
     def _snapshot_grads(self, grads):
         """D2H of the grad tree per UNIQUE param shard (main thread — the jax
@@ -225,10 +272,12 @@ class HostOffloadOptimizer:
                  for _, g in _leaf_paths(grads))
         return float(jnp.sqrt(sq))
 
-    def _host_work(self, host_grads, order, step_num, gnorm: float) -> bool:
+    def _host_work(self, host_grads, order, step_num, gnorm: float,
+                   done_cb=None) -> bool:
         """clip + fused Adam over the host buffers (pure numpy/C++ — safe on
         the background worker; ``gnorm`` precomputed on the main thread).
-        Returns skipped."""
+        ``done_cb(shard_key)`` fires as each shard's update lands (the
+        streamed-upload consumer). Returns skipped."""
         lr = float(self.schedule_fn(step_num)) if self.schedule_fn else self.base_lr
         self._last_gnorm = gnorm
         if not np.isfinite(gnorm):
@@ -237,32 +286,15 @@ class HostOffloadOptimizer:
             scale = self.gradient_clipping / (gnorm + 1e-6)
             # fresh arrays: host_grads may alias the live device buffers
             host_grads = {n: g * scale for n, g in host_grads.items()}
-        self._run_adam(host_grads, order, lr)
+        self._run_adam(host_grads, order, lr, done_cb)
         return False
 
     def _run_adam(self, host_grads: Dict[str, np.ndarray], order: List[str],
-                  lr: float) -> None:
+                  lr: float, done_cb=None) -> None:
         self.adam.step_count += 1
-        if self.swapper is not None:
-            # pipelined: prefetch next moments while updating current
-            m_cur = self.swapper.swap_in(order[0] + ".m")
-            v_cur = self.swapper.swap_in(order[0] + ".v")
-            for i, name in enumerate(order):
-                nxt = order[i + 1] if i + 1 < len(order) else None
-                if nxt:
-                    m_nxt = self.swapper.swap_in_start(nxt + ".m")
-                    v_nxt = self.swapper.swap_in_start(nxt + ".v")
-                flat = self.master[name].reshape(-1)
-                self.adam.step(flat, host_grads[name].reshape(-1),
-                               m_cur.reshape(-1), v_cur.reshape(-1), lr=lr,
-                               increment=False)
-                self.swapper.wait()  # finish prefetch (+ prior writeback)
-                self.swapper.swap_out(name + ".m", m_cur)
-                self.swapper.swap_out(name + ".v", v_cur)
-                if nxt:
-                    m_cur, v_cur = m_nxt, v_nxt
-            self.swapper.wait()
-        else:
+        t_loop = time.perf_counter()
+        stall = 0.0
+        if self.swapper is None:
             # sequential per leaf: the C++ kernel already spreads each call
             # across all host cores (omp parallel for in csrc/cpu_adam.cpp)
             for name in order:
@@ -270,35 +302,201 @@ class HostOffloadOptimizer:
                                host_grads[name].reshape(-1),
                                self.m[name].reshape(-1), self.v[name].reshape(-1),
                                lr=lr, increment=False)
+                if done_cb is not None:
+                    done_cb(name)
+        elif self.prefetch_depth <= 0:
+            # strictly serial swap path: read → Adam → write → barrier per
+            # leaf. No overlap — the oracle the pipeline must match
+            # bit-exactly (and the depth knob's off switch).
+            for name in order:
+                t0 = time.perf_counter()
+                m = self.swapper.swap_in(name + ".m")
+                v = self.swapper.swap_in(name + ".v")
+                stall += time.perf_counter() - t0
+                self.adam.step(self.master[name].reshape(-1),
+                               host_grads[name].reshape(-1),
+                               m.reshape(-1), v.reshape(-1), lr=lr,
+                               increment=False)
+                t0 = time.perf_counter()
+                self.swapper.swap_out(name + ".m", m).wait()
+                self.swapper.swap_out(name + ".v", v).wait()
+                stall += time.perf_counter() - t0
+                if done_cb is not None:
+                    done_cb(name)
+        else:
+            self._run_adam_pipelined(host_grads, order, lr, done_cb)
+            return
+        total = time.perf_counter() - t_loop
+        self._record_adam(total, stall)
+
+    def _run_adam_pipelined(self, host_grads, order, lr, done_cb) -> None:
+        """Depth-k swap pipeline: read leaf i+k, Adam leaf i, write leaf i-1
+        concurrently. Per-op tickets mean a writeback never fences the next
+        prefetch; reads/writes of ONE leaf chunk across the whole AIO
+        threadpool. Per-leaf updates are independent, so the result is
+        bit-identical to the serial path."""
+        sw = self.swapper
+        k = self.prefetch_depth
+        reads: Dict[str, tuple] = {}
+        writes = deque()
+        stall = 0.0
+        t_loop = time.perf_counter()
+
+        def prefetch(j: int) -> None:
+            n = order[j]
+            reads[n] = (sw.swap_in_start(n + ".m"),
+                        sw.swap_in_start(n + ".v"))
+
+        try:
+            for j in range(min(k, len(order))):
+                prefetch(j)
+            nxt = min(k, len(order))
+            for name in order:
+                if nxt < len(order):
+                    prefetch(nxt)
+                    nxt += 1
+                mt, vt = reads.pop(name)
+                t0 = time.perf_counter()
+                m = mt.wait()
+                v = vt.wait()
+                stall += time.perf_counter() - t0
+                self.adam.step(self.master[name].reshape(-1),
+                               host_grads[name].reshape(-1),
+                               m.reshape(-1), v.reshape(-1), lr=lr,
+                               increment=False)
+                # swap_out copies into a fresh pooled write buffer, so the
+                # read loan can return to the pool immediately
+                writes.append(sw.swap_out(name + ".m", m))
+                writes.append(sw.swap_out(name + ".v", v))
+                mt.release()
+                vt.release()
+                # reap old writebacks lazily — bounds the pool loan-out at
+                # ~2 leaves of writes + k leaves of reads
+                while len(writes) > 4 * k:
+                    t0 = time.perf_counter()
+                    writes.popleft().wait()
+                    stall += time.perf_counter() - t0
+                if done_cb is not None:
+                    done_cb(name)
+            while writes:
+                t0 = time.perf_counter()
+                writes.popleft().wait()
+                stall += time.perf_counter() - t0
+        except BaseException:
+            # clean abort: drain the native queue and return EVERY pooled
+            # buffer (read loans included) before propagating — no torn
+            # state handles, pool fully restored for the retry/shutdown
+            sw.abort()
+            raise
+        total = time.perf_counter() - t_loop
+        self._record_adam(total, stall)
+
+    def _record_adam(self, total_s: float, stall_s: float) -> None:
+        self._adam_ms = total_s * 1e3
+        self._stall_fraction = (stall_s / total_s) if total_s > 0 else 0.0
+        obs = self._obs()
+        if obs is not None:
+            obs["adam_ms"].observe(self._adam_ms)
+            if self.swapper is not None:
+                obs["stall"].set(self._stall_fraction)
+
+    def _obs(self):
+        """offload/* instruments in the process registry (lazy, never
+        required — metrics must not make the optimizer importable-order
+        sensitive)."""
+        if self._obs_instruments is None:
+            try:
+                from deepspeed_tpu.observability.registry import get_registry
+
+                reg = get_registry()
+                self._obs_instruments = {
+                    "adam_ms": reg.histogram(
+                        "offload/adam_ms", "host Adam loop duration"),
+                    "upload_ms": reg.histogram(
+                        "offload/upload_ms", "masters→device upload"),
+                    "stall": reg.gauge(
+                        "offload/pipeline_stall_fraction",
+                        "fraction of the Adam loop blocked on swap IO"),
+                }
+            except Exception:
+                return None
+        return self._obs_instruments
+
+    def _upload_leaf(self, name: str, leaf):
+        """ONE leaf's masters → device, preserving its sharding + dtype
+        (H2D volume = the sharded size; replicas re-materialize on device
+        from the one host buffer). Main thread only (jax client)."""
+        copy = _aliasing_backend()  # device_put must not alias the mutable master
+        layout = self._layout[name]
+        if layout[0][1] is None:  # legacy full-leaf tier
+            arr = self.master[f"{name}#0"].astype(leaf.dtype, copy=copy)
+            return jax.device_put(arr.reshape(leaf.shape), leaf.sharding)
+        target = self._state_sh.get(name, leaf.sharding)
+        bufs = []
+        for i, (key, devs) in enumerate(layout):
+            arr = self.master[f"{name}#{i}"].astype(leaf.dtype, copy=copy)
+            for d in devs:
+                bufs.append(jax.device_put(arr, d))
+        sharded = jax.make_array_from_single_device_arrays(
+            leaf.shape, target, bufs)
+        # H2D moved only the state shards; re-materializing the (possibly
+        # replicated) param layout is a device-side collective
+        return (sharded if target == leaf.sharding
+                else jax.device_put(sharded, leaf.sharding))
 
     def _upload(self, params: Any):
-        """masters → device per shard, preserving each leaf's sharding +
-        dtype (H2D volume = the sharded size; replicas re-materialize on
-        device from the one host buffer)."""
-        copy = _aliasing_backend()  # device_put must not alias the mutable master
-        new_flat = {}
-        for name, leaf in _leaf_paths(params):
-            layout = self._layout[name]
-            if layout[0][1] is None:  # legacy full-leaf tier
-                arr = self.master[f"{name}#0"].astype(leaf.dtype, copy=copy)
-                new_flat[name] = jax.device_put(arr.reshape(leaf.shape),
-                                                leaf.sharding)
-                continue
-            target = self._state_sh.get(name, leaf.sharding)
-            bufs = []
-            for i, (key, devs) in enumerate(layout):
-                arr = self.master[f"{name}#{i}"].astype(leaf.dtype, copy=copy)
-                for d in devs:
-                    bufs.append(jax.device_put(arr, d))
-            sharded = jax.make_array_from_single_device_arrays(
-                leaf.shape, target, bufs)
-            # H2D moved only the state shards; re-materializing the (possibly
-            # replicated) param layout is a device-side collective
-            new_flat[name] = (sharded if target == leaf.sharding
-                              else jax.device_put(sharded, leaf.sharding))
+        """masters → device for every leaf (the non-overlapped path)."""
+        t0 = time.perf_counter()
+        new_flat = {name: self._upload_leaf(name, leaf)
+                    for name, leaf in _leaf_paths(params)}
+        self._record_upload(time.perf_counter() - t0)
         treedef = jax.tree_util.tree_structure(params)
         ordered = [new_flat[n] for n, _ in _leaf_paths(params)]
         return jax.tree_util.tree_unflatten(treedef, ordered)
+
+    def _upload_streamed(self, params: Any, order: List[str], done_q, fut):
+        """Consume shard-completion events from the Adam worker and
+        ``device_put`` each leaf the moment its LAST shard updates — the
+        upload of early leaves overlaps the Adam of later ones. Runs on the
+        caller's thread (the only thread that may touch the jax client)."""
+        leaf_map = dict(_leaf_paths(params))
+        pending: Dict[str, int] = {}
+        for skey in order:
+            name = skey.rsplit("#", 1)[0]
+            pending[name] = pending.get(name, 0) + 1
+        new_flat = {}
+        t_up = 0.0
+        while pending:
+            try:
+                skey = done_q.get(timeout=0.2)
+            except _queue.Empty:
+                if fut.done():
+                    fut.result()  # surface the worker's exception
+                    for name in list(pending):  # defensive tail flush
+                        t0 = time.perf_counter()
+                        new_flat[name] = self._upload_leaf(
+                            name, leaf_map[name])
+                        t_up += time.perf_counter() - t0
+                        del pending[name]
+                continue
+            name = skey.rsplit("#", 1)[0]
+            pending[name] -= 1
+            if pending[name] == 0:
+                del pending[name]
+                t0 = time.perf_counter()
+                new_flat[name] = self._upload_leaf(name, leaf_map[name])
+                t_up += time.perf_counter() - t0
+        fut.result()  # re-raise late worker failures before committing
+        self._record_upload(t_up)
+        treedef = jax.tree_util.tree_structure(params)
+        ordered = [new_flat[n] for n, _ in _leaf_paths(params)]
+        return jax.tree_util.tree_unflatten(treedef, ordered)
+
+    def _record_upload(self, seconds: float) -> None:
+        self._upload_ms = seconds * 1e3
+        obs = self._obs()
+        if obs is not None:
+            obs["upload_ms"].observe(self._upload_ms)
 
     # ------------------------------------------------------------------
     # ZenFlow overlap: async step with 1-step bounded staleness
@@ -333,6 +531,40 @@ class HostOffloadOptimizer:
         return self._upload(params), False
 
     # ------------------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        """``resilience_report()``-style snapshot of the offload data path:
+        tier layout, pipeline configuration, last-step stage timings, the
+        measured stall fraction, and the swapper's pool/bandwidth state."""
+        rep: Dict[str, Any] = {
+            "device": "nvme" if self.swapper is not None else "cpu",
+            "shards": len(self.master),
+            "master_params_m": round(
+                sum(a.size for a in self.master.values()) / 1e6, 3),
+            "overlap_step": self.overlap,
+            "upload_overlap": self._upload_overlap,
+            "prefetch_depth": self.prefetch_depth,
+            "last_adam_ms": round(self._adam_ms, 3),
+            "last_upload_ms": round(self._upload_ms, 3),
+            "pipeline_stall_fraction": round(self._stall_fraction, 4),
+        }
+        if self.swapper is not None:
+            rep["swapper"] = self.swapper.report()
+        return rep
+
+    def close(self) -> None:
+        """Release the worker pools, THEN the AIO handle (a worker mid-step
+        may still be submitting swap ops — destroying the handle under it
+        would be the use-after-free the swapper's close() exists to
+        prevent). Idempotent."""
+        if self._adam_pool is not None:
+            self._adam_pool.shutdown(wait=True)
+            self._adam_pool = None
+        if self._worker is not None:
+            self._worker.shutdown(wait=True)
+            self._worker = None
+        if self.swapper is not None:
+            self.swapper.close()
+
     def state_dict(self) -> Dict[str, np.ndarray]:
         return self._state_dict_base()
 
@@ -352,7 +584,15 @@ class HostOffloadOptimizer:
             return self._shard_get(kind, f"{name}#0")
         full = np.zeros(self._shapes[name], np.float32)
         for i, (key, _d) in enumerate(layout):
-            full[_key_slices(key)] = self._shard_get(kind, f"{name}#{i}")
+            if kind != "master" and self.swapper is not None:
+                # copy straight from the pooled read view into the
+                # assembled array — one memcpy, not swap_in's owned-copy
+                # detour (checkpoint state is multi-GB on big runs)
+                t = self.swapper.swap_in_start(f"{name}#{i}.{kind}")
+                full[_key_slices(key)] = t.wait()
+                t.release()
+            else:
+                full[_key_slices(key)] = self._shard_get(kind, f"{name}#{i}")
         return full
 
     def _set_full_leaf(self, kind: str, name: str, val: np.ndarray) -> None:
@@ -363,7 +603,10 @@ class HostOffloadOptimizer:
             if kind == "master":
                 self.master[skey] = piece
             elif self.swapper is not None:
-                self.swapper.swap_out(f"{skey}.{kind}", piece)
+                self._init_writes.append(
+                    self.swapper.swap_out(f"{skey}.{kind}", piece))
+                while len(self._init_writes) > 32:
+                    self._init_writes.popleft().wait()
             else:
                 getattr(self, kind)[skey] = piece
 
@@ -396,6 +639,7 @@ class HostOffloadOptimizer:
                 self._set_full_leaf(kind, name, val)
         if self.swapper is not None:
             self.swapper.wait()
+            self._init_writes.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -4,112 +4,515 @@ Parity target: ``deepspeed/runtime/swap_tensor/`` — ``AsyncPartitionedParamete
 (partitioned_param_swapper.py:37) and ``PartitionedOptimizerSwapper``: tensors move
 host↔NVMe through the native AIO threadpool with overlap (submit now, wait at the
 point of use).
+
+Data path (this module owns the host side of the offload pipeline):
+
+* **Pooled pinned buffers** — every IO moves through a reusable aligned
+  bounce buffer from a :class:`PinnedBufferPool` (the reference's pinned swap
+  buffers, ``swap_tensor/utils.py``). The caller's array is copied in at
+  submit time, so two back-to-back ``swap_out`` calls of the same name can
+  never alias an in-flight buffer, and steady-state training allocates zero
+  new host memory per step.
+* **Per-op completion** — ``swap_out``/``swap_in_start`` return a
+  :class:`SwapTicket` that is waited *individually* (``ds_aio_wait_op``), so
+  one leaf's moment writeback no longer blocks the next leaf's prefetch at a
+  shared barrier. The legacy :meth:`AsyncTensorSwapper.wait` barrier still
+  drains everything.
+* **Chunked leaf IO** — arrays larger than ``chunk_bytes`` are split into
+  block-sized chunks submitted as independent ops at file offsets, so a
+  single 64 MB moment array spreads across the whole AIO threadpool instead
+  of serializing on one worker.
+* **Self-tuning** — ``autotune=True`` adopts the best thread-count ×
+  chunk-size from a short :func:`deepspeed_tpu.ops.aio_bench.autotune_config`
+  sweep (cached per swap-dir device).
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-from typing import Dict, Optional
+import threading
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+from deepspeed_tpu.utils.logging import logger
 
+__all__ = ["AsyncTensorSwapper", "PinnedBufferPool", "SwapTicket"]
 
 _ALIGN = 4096  # O_DIRECT requires block-aligned buffers, sizes, and offsets
+_DEFAULT_THREADS = 4
+_DEFAULT_CHUNK_MB = 8
 
 
-def _aligned_buffer(nbytes: int):
-    """(backing array to keep alive, aligned uint8 view of padded size)."""
-    padded = -(-nbytes // _ALIGN) * _ALIGN
-    raw = np.empty(padded + _ALIGN, np.uint8)
-    off = (-raw.ctypes.data) % _ALIGN
-    return raw, raw[off:off + padded]
+def _padded(nbytes: int) -> int:
+    return max(_ALIGN, -(-nbytes // _ALIGN) * _ALIGN)
+
+
+class PinnedBuffer:
+    """One aligned host buffer owned by a :class:`PinnedBufferPool`."""
+
+    __slots__ = ("raw", "data", "capacity")
+
+    def __init__(self, capacity: int):
+        raw = np.empty(capacity + _ALIGN, np.uint8)
+        off = (-raw.ctypes.data) % _ALIGN
+        self.raw = raw
+        self.data = raw[off:off + capacity]  # aligned uint8 view
+        self.capacity = capacity
+
+    def addr(self, offset: int = 0) -> ctypes.c_void_p:
+        return ctypes.c_void_p(self.data.ctypes.data + offset)
+
+
+class PinnedBufferPool:
+    """Reusable aligned bounce buffers (pinned-buffer pool parity).
+
+    ``get`` best-fits the smallest cached buffer whose capacity covers the
+    request (but never one more than 2x the need — a giant buffer must not be
+    consumed by tiny requests); a miss allocates fresh. ``put`` recycles up
+    to ``max_cached`` buffers and drops the rest. In steady state (the same
+    leaf sizes every optimizer step) the pool stops allocating entirely.
+    """
+
+    def __init__(self, max_cached: int = 32):
+        self.max_cached = max_cached
+        self._free: List[PinnedBuffer] = []
+        self._lock = threading.Lock()
+        self.allocations = 0     # fresh buffer allocations (growth indicator)
+        self.reuses = 0
+        self.outstanding = 0     # buffers currently held by callers
+        self.bytes_allocated = 0
+
+    def get(self, nbytes: int) -> PinnedBuffer:
+        need = _padded(nbytes)
+        with self._lock:
+            best = None
+            for b in self._free:
+                if need <= b.capacity <= 2 * need and \
+                        (best is None or b.capacity < best.capacity):
+                    best = b
+            if best is not None:
+                self._free.remove(best)
+                self.reuses += 1
+                self.outstanding += 1
+                return best
+            self.allocations += 1
+            self.bytes_allocated += need
+            self.outstanding += 1
+        return PinnedBuffer(need)
+
+    def put(self, buf: PinnedBuffer) -> None:
+        with self._lock:
+            self.outstanding -= 1
+            if len(self._free) < self.max_cached:
+                self._free.append(buf)
+            else:
+                self.bytes_allocated -= buf.capacity
+
+    def report(self) -> Dict[str, int]:
+        with self._lock:
+            return {"allocations": self.allocations, "reuses": self.reuses,
+                    "outstanding": self.outstanding,
+                    "cached": len(self._free),
+                    "bytes_allocated": self.bytes_allocated}
+
+
+class SwapTicket:
+    """Handle for one in-flight swap (possibly many chunked native ops).
+
+    ``wait()`` blocks on this ticket's ops only. For reads it returns the
+    decoded array — a zero-copy view over the pooled buffer, which stays
+    loaned out until :meth:`release` (call it once the data has been consumed
+    or copied). Writes release their buffer back to the pool inside
+    ``wait()`` automatically.
+    """
+
+    __slots__ = ("swapper", "tid", "kind", "name", "op_ids", "buf", "nbytes",
+                 "shape", "dtype", "t_submit", "_done", "_released", "_view",
+                 "_failed")
+
+    def __init__(self, swapper: "AsyncTensorSwapper", tid: int, kind: str,
+                 name: str, op_ids: List[int], buf: PinnedBuffer, nbytes: int,
+                 shape: Optional[tuple] = None, dtype=None):
+        self.swapper = swapper
+        self.tid = tid
+        self.kind = kind                  # "r" | "w"
+        self.name = name
+        self.op_ids = op_ids
+        self.buf = buf
+        self.nbytes = nbytes
+        self.shape = shape
+        self.dtype = dtype
+        self.t_submit = time.perf_counter()
+        self._done = False
+        self._released = False
+        self._failed = False   # a reaped chunk errored (sticky across polls)
+        self._view: Optional[np.ndarray] = None
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def poll(self) -> bool:
+        """Non-blocking completion probe; reaps finished ops as it goes."""
+        if self._done:
+            return True
+        lib, h = self.swapper.lib, self.swapper.handle
+        remaining = []
+        for oid in self.op_ids:
+            st = lib.ds_aio_poll_op(h, ctypes.c_int64(oid))
+            if st == 0:
+                remaining.append(oid)
+            elif st < 0:
+                # sticky: the native error was reaped HERE — a later
+                # poll/wait must still surface it even though the remaining
+                # chunks succeed
+                self._failed = True
+        self.op_ids = remaining
+        if remaining:
+            return False
+        self._complete(self._failed)
+        return True
+
+    def wait(self) -> Optional[np.ndarray]:
+        """Block until this ticket's ops finish; read tickets return the
+        array view (valid until :meth:`release`)."""
+        if not self._done:
+            lib, h = self.swapper.lib, self.swapper.handle
+            failed = self._failed
+            for oid in self.op_ids:
+                if lib.ds_aio_wait_op(h, ctypes.c_int64(oid)) != 0:
+                    failed = True
+            self.op_ids = []
+            self._complete(failed)
+        return self._view
+
+    def _complete(self, failed: bool) -> None:
+        self._done = True
+        sw = self.swapper
+        sw._inflight.pop(self.tid, None)
+        elapsed_ms = (time.perf_counter() - self.t_submit) * 1e3
+        if failed:
+            self._release_buf()
+            sw._record_io(self.kind, self.nbytes, elapsed_ms, error=True)
+            raise IOError(
+                f"async {'read' if self.kind == 'r' else 'write'} of "
+                f"{self.name!r} failed in {sw.swap_dir}")
+        sw._record_io(self.kind, self.nbytes, elapsed_ms, error=False)
+        if self.kind == "r":
+            self._view = (self.buf.data[:self.nbytes].view(self.dtype)
+                          .reshape(self.shape))
+            # the buffer is now a LOAN to the caller: tracked until
+            # release() so abort()/close() can always restore the pool
+            sw._loans[self.tid] = self
+        else:
+            self._release_buf()
+
+    def release(self) -> None:
+        """Return a read ticket's pooled buffer (idempotent; implies wait)."""
+        if not self._done:
+            self.wait()
+        self._view = None
+        self.swapper._loans.pop(self.tid, None)
+        self._release_buf()
+
+    def _release_buf(self) -> None:
+        if not self._released and self.buf is not None:
+            self._released = True
+            self.swapper.pool.put(self.buf)
+            self.buf = None
 
 
 class AsyncTensorSwapper:
     """Write/read named fp32 host arrays to files asynchronously.
 
-    ``o_direct=True`` bypasses the page cache: data moves through block-
-    aligned padded bounce buffers (the reference's aligned pinned buffers,
-    swap_tensor/utils.py) — the memcpy is negligible next to device IO."""
+    ``o_direct=True`` bypasses the page cache: data moves through the same
+    block-aligned pooled buffers with padded file sizes. ``chunk_mb`` caps
+    the per-op IO size — larger tensors are split across the threadpool.
+    ``num_threads=0`` / ``chunk_mb=0`` mean "auto": adopt the autotuned
+    config when ``autotune=True``, else the defaults (4 threads, 8 MB).
+    """
 
-    def __init__(self, swap_dir: str, num_threads: int = 2, o_direct: bool = False):
+    def __init__(self, swap_dir: str, num_threads: int = 0,
+                 o_direct: bool = False, chunk_mb: int = 0,
+                 autotune: bool = False, autotune_cache: str = "",
+                 pool: Optional[PinnedBufferPool] = None):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.o_direct = o_direct
+        self.autotuned: Optional[dict] = None
+        if autotune and (num_threads <= 0 or chunk_mb <= 0):
+            try:
+                from deepspeed_tpu.ops.aio_bench import autotune_config
+
+                self.autotuned = autotune_config(
+                    swap_dir, cache_path=autotune_cache or None,
+                    o_direct=o_direct)
+                if num_threads <= 0:
+                    num_threads = int(self.autotuned["threads"])
+                if chunk_mb <= 0:
+                    chunk_mb = int(self.autotuned["chunk_mb"])
+            except Exception as e:  # autotune must never block training
+                logger.warning(f"aio autotune failed ({e}); using defaults")
+        self.num_threads = num_threads if num_threads > 0 else _DEFAULT_THREADS
+        self.chunk_bytes = _padded(
+            (chunk_mb if chunk_mb > 0 else _DEFAULT_CHUNK_MB) * (1 << 20))
         lib = AsyncIOBuilder().load()
         lib.ds_aio_handle_create.restype = ctypes.c_void_p
         lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_void_p, ctypes.c_int64,
                                       ctypes.c_int64, ctypes.c_int]
         lib.ds_aio_pread.argtypes = list(lib.ds_aio_pwrite.argtypes)
+        lib.ds_aio_submit_pwrite.argtypes = list(lib.ds_aio_pwrite.argtypes)
+        lib.ds_aio_submit_pwrite.restype = ctypes.c_int64
+        lib.ds_aio_submit_pread.argtypes = list(lib.ds_aio_pwrite.argtypes)
+        lib.ds_aio_submit_pread.restype = ctypes.c_int64
+        lib.ds_aio_wait_op.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_wait_op.restype = ctypes.c_int
+        lib.ds_aio_poll_op.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_poll_op.restype = ctypes.c_int
         lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
         lib.ds_aio_wait.restype = ctypes.c_int64
         lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
         lib.ds_aio_pending.restype = ctypes.c_int64
+        lib.ds_aio_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+        lib.ds_aio_handle_destroy.argtypes = [ctypes.c_void_p]
         self.lib = lib
-        self.handle = lib.ds_aio_handle_create(num_threads)
+        self.handle = lib.ds_aio_handle_create(self.num_threads)
+        self.pool = pool if pool is not None else PinnedBufferPool()
         self._meta: Dict[str, tuple] = {}
-        # buffers in flight must stay referenced until wait() (reference pins them)
-        self._inflight: Dict[str, np.ndarray] = {}
+        # in-flight tickets keyed by a monotonically increasing ticket id —
+        # NOT by name: two swap_outs of the same name each pin their own
+        # pooled buffer until their own ops complete
+        self._inflight: Dict[int, SwapTicket] = {}
+        # completed read tickets whose pooled buffer is loaned out (view in
+        # the caller's hands) until ticket.release()
+        self._loans: Dict[int, SwapTicket] = {}
+        self._next_tid = 0
+        self._metrics = None  # lazy: offload/* instruments
 
+    # ------------------------------------------------------------------
     def _path(self, name: str) -> bytes:
-        return os.path.join(self.swap_dir, name.replace("/", "_") + ".swp").encode()
+        return os.path.join(self.swap_dir,
+                            name.replace("/", "_") + ".swp").encode()
 
-    def swap_out(self, name: str, array: np.ndarray) -> None:
-        """Submit an async write; the array buffer is held until ``wait``."""
-        arr = np.ascontiguousarray(array)
-        self._meta[name] = (arr.shape, arr.dtype)
-        if self.o_direct:
-            raw, buf = _aligned_buffer(arr.nbytes)
-            buf[:arr.nbytes] = arr.view(np.uint8).reshape(-1)
-            self._inflight["w:" + name] = raw
-            self.lib.ds_aio_pwrite(self.handle, self._path(name),
-                                   buf.ctypes.data_as(ctypes.c_void_p),
-                                   buf.nbytes, 0, 1)
+    def _instruments(self):
+        if self._metrics is None:
+            from deepspeed_tpu.observability.registry import (
+                exponential_bounds, get_registry)
+
+            reg = get_registry()
+            ms_bounds = [b / 16 for b in exponential_bounds()]  # 16µs..~2s
+            self._metrics = {
+                "r_ms": reg.histogram("offload/swap_in_ms",
+                                      "swap read submit→complete latency",
+                                      bounds=ms_bounds),
+                "w_ms": reg.histogram("offload/swap_out_ms",
+                                      "swap write submit→complete latency",
+                                      bounds=ms_bounds),
+                "r_bytes": reg.counter("offload/bytes_read",
+                                       "bytes read from swap files"),
+                "w_bytes": reg.counter("offload/bytes_written",
+                                       "bytes written to swap files"),
+                "errors": reg.counter("offload/io_errors",
+                                      "failed swap IO tickets"),
+            }
+        return self._metrics
+
+    def _record_io(self, kind: str, nbytes: int, elapsed_ms: float,
+                   error: bool) -> None:
+        m = self._instruments()
+        if error:
+            m["errors"].inc()
             return
-        self._inflight["w:" + name] = arr
-        self.lib.ds_aio_pwrite(self.handle, self._path(name),
-                               arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, 0,
-                               0)
+        if kind == "r":
+            m["r_ms"].observe(elapsed_ms)
+            m["r_bytes"].inc(nbytes)
+        else:
+            m["w_ms"].observe(elapsed_ms)
+            m["w_bytes"].inc(nbytes)
 
-    def swap_in_start(self, name: str) -> np.ndarray:
-        """Submit an async read into a fresh buffer; call ``wait`` before use."""
+    def _fire_fault(self, site: str) -> None:
+        from deepspeed_tpu.resilience.faults import get_injector
+
+        get_injector().on_swap_io(site)
+
+    def _submit_chunks(self, kind: str, path: bytes, buf: PinnedBuffer,
+                       nbytes: int) -> List[int]:
+        """Split ``nbytes`` of ``buf`` into chunk-sized native ops at file
+        offsets; one op per chunk spreads a large leaf over all workers."""
+        submit = (self.lib.ds_aio_submit_pread if kind == "r"
+                  else self.lib.ds_aio_submit_pwrite)
+        od = 1 if self.o_direct else 0
+        ids = []
+        off = 0
+        while off < nbytes:
+            n = min(self.chunk_bytes, nbytes - off)
+            ids.append(submit(self.handle, path, buf.addr(off),
+                              ctypes.c_int64(n), ctypes.c_int64(off), od))
+            off += n
+        return ids
+
+    def _new_ticket(self, kind: str, name: str, op_ids: List[int],
+                    buf: PinnedBuffer, nbytes: int, shape=None,
+                    dtype=None) -> SwapTicket:
+        self._next_tid += 1
+        t = SwapTicket(self, self._next_tid, kind, name, op_ids, buf, nbytes,
+                       shape, dtype)
+        self._inflight[t.tid] = t
+        return t
+
+    # ------------------------------------------------------------------
+    def swap_out(self, name: str, array: np.ndarray) -> SwapTicket:
+        """Copy ``array`` into a pooled buffer and submit an async (chunked)
+        write. The caller's array is free for reuse immediately; the pooled
+        buffer returns automatically when the ticket is waited/barriered."""
+        self._fire_fault("swap_write")
+        arr = np.ascontiguousarray(array)
+        self._meta[name] = (tuple(arr.shape), arr.dtype)
+        nbytes = arr.nbytes
+        io_bytes = _padded(nbytes) if self.o_direct else nbytes
+        buf = self.pool.get(io_bytes)
+        buf.data[:nbytes] = arr.view(np.uint8).reshape(-1)
+        if io_bytes > nbytes:
+            buf.data[nbytes:io_bytes] = 0
+        ids = self._submit_chunks("w", self._path(name), buf, io_bytes)
+        return self._new_ticket("w", name, ids, buf, nbytes)
+
+    def swap_in_start(self, name: str) -> SwapTicket:
+        """Submit an async (chunked) read into a pooled buffer. ``wait()``
+        on the returned ticket yields the array (a view over the pool buffer
+        — call ``release()`` once consumed)."""
+        self._fire_fault("swap_read")
         shape, dtype = self._meta[name]
         nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        if self.o_direct:
-            raw, buf = _aligned_buffer(nbytes)
-            self._inflight["r:" + name] = raw
-            self.lib.ds_aio_pread(self.handle, self._path(name),
-                                  buf.ctypes.data_as(ctypes.c_void_p),
-                                  buf.nbytes, 0, 1)
-            # a view over the aligned buffer: valid once wait() completes
-            return buf[:nbytes].view(dtype).reshape(shape)
-        out = np.empty(shape, dtype)
-        self._inflight["r:" + name] = out
-        self.lib.ds_aio_pread(self.handle, self._path(name),
-                              out.ctypes.data_as(ctypes.c_void_p), out.nbytes, 0,
-                              0)
-        return out
+        io_bytes = _padded(nbytes) if self.o_direct else nbytes
+        buf = self.pool.get(io_bytes)
+        ids = self._submit_chunks("r", self._path(name), buf, io_bytes)
+        return self._new_ticket("r", name, ids, buf, nbytes, shape, dtype)
 
     def swap_in(self, name: str) -> np.ndarray:
-        out = self.swap_in_start(name)
-        self.wait()
+        """Blocking read returning an owned array (buffer goes back to the
+        pool before returning)."""
+        t = self.swap_in_start(name)
+        view = t.wait()
+        out = np.array(view)  # owned copy — the view's buffer is recycled
+        t.release()
         return out
 
+    # ------------------------------------------------------------------
     def wait(self) -> None:
-        errors = self.lib.ds_aio_wait(self.handle)
+        """Barrier: drain EVERY submitted op, finalize all tickets, release
+        write buffers (read tickets keep their loaned buffer until
+        ``release()``). Raises on any failed op since the last barrier —
+        and since the barrier can't attribute the failure to one ticket, NO
+        in-flight read ticket gets a view on the error path (their buffers
+        return to the pool; consuming a maybe-garbage view would silently
+        corrupt optimizer state)."""
+        if not getattr(self, "handle", None):
+            return
+        errors = int(self.lib.ds_aio_wait(self.handle))
+        now = time.perf_counter()
+        sticky = 0
+        for t in list(self._inflight.values()):
+            t.op_ids = []          # reaped by the barrier
+            t._done = True
+            if errors or t._failed:
+                # t._failed: a chunk failure already reaped by poll() (the
+                # native error counter was decremented there) — it must not
+                # be laundered into success by the barrier
+                if t._failed:
+                    sticky += 1
+                    self._record_io(t.kind, t.nbytes,
+                                    (now - t.t_submit) * 1e3, error=True)
+                t._view = None
+                t._release_buf()
+            elif t.kind == "w":
+                self._record_io("w", t.nbytes, (now - t.t_submit) * 1e3,
+                                error=False)
+                t._release_buf()
+            else:
+                self._record_io("r", t.nbytes, (now - t.t_submit) * 1e3,
+                                error=False)
+                t._view = (t.buf.data[:t.nbytes].view(t.dtype)
+                           .reshape(t.shape))
+                self._loans[t.tid] = t
         self._inflight.clear()
-        if errors:
-            raise IOError(f"{errors} async IO operations failed in {self.swap_dir}")
+        if errors or sticky:
+            if errors:
+                self._instruments()["errors"].inc(errors)
+            raise IOError(f"{errors + sticky} async IO operations failed "
+                          f"in {self.swap_dir}")
+
+    def abort(self) -> None:
+        """Error-path cleanup: drain the native queue, drop every in-flight
+        ticket, and return ALL pooled buffers (including read loans). Never
+        raises — callers are already propagating the original failure."""
+        try:
+            if self.handle:
+                self.lib.ds_aio_wait(self.handle)
+        except Exception:
+            pass
+        for t in list(self._inflight.values()) + list(self._loans.values()):
+            t.op_ids = []
+            t._done = True
+            t._view = None
+            t._release_buf()
+        self._inflight.clear()
+        self._loans.clear()
 
     @property
     def pending(self) -> int:
+        if not getattr(self, "handle", None):
+            return 0
         return int(self.lib.ds_aio_pending(self.handle))
 
+    def bandwidth(self) -> Dict[str, float]:
+        """Measured device bandwidth from the native per-direction stats
+        (bytes over the union of in-flight windows — overlap not
+        double-counted)."""
+        if not getattr(self, "handle", None):
+            return {"read_bytes": 0, "write_bytes": 0,
+                    "read_MBps": 0.0, "write_MBps": 0.0}
+        out = (ctypes.c_int64 * 4)()
+        self.lib.ds_aio_stats(self.handle, out)
+        rb, rns, wb, wns = out[0], out[1], out[2], out[3]
+        return {
+            "read_bytes": int(rb), "write_bytes": int(wb),
+            "read_MBps": round(rb / 1e6 / (rns / 1e9), 1) if rns else 0.0,
+            "write_MBps": round(wb / 1e6 / (wns / 1e9), 1) if wns else 0.0,
+        }
+
+    def report(self) -> Dict:
+        """One-call state snapshot (offload_report() building block)."""
+        return {
+            "threads": self.num_threads,
+            "chunk_mb": self.chunk_bytes >> 20,
+            "o_direct": self.o_direct,
+            "autotuned": self.autotuned,
+            "pending_ops": self.pending if self.handle else 0,
+            "inflight_tickets": len(self._inflight),
+            "loaned_read_buffers": len(self._loans),
+            "pool": self.pool.report(),
+            **self.bandwidth(),
+        }
+
     def close(self) -> None:
-        if self.handle:
-            self.lib.ds_aio_handle_destroy(ctypes.c_void_p(self.handle))
-            self.handle = None
+        """Idempotent shutdown: drain pending ops (the destroy would
+        otherwise free the queue under live workers), release buffers,
+        destroy the native handle."""
+        if not getattr(self, "handle", None):
+            return
+        self.abort()
+        self.lib.ds_aio_handle_destroy(ctypes.c_void_p(self.handle))
+        self.handle = None
+
+    def __del__(self):  # best-effort: don't leak native threads
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -20,6 +20,12 @@ Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
   corrupts files after the save so verification must reject the tag.
 * ``"checkpoint_io"`` — checkpoint IO entry; ``io_error`` raises for the first
   ``times`` calls (retry testing).
+* ``"swap_read"`` / ``"swap_write"`` — the offload swapper's submit hooks
+  (``offload/swap.py``); an ``io_error`` spec whose ``site`` names one of
+  them fires mid-pipeline in the NVMe optimizer path (drilled by
+  ``tools/offload_drill.py``). Site is REQUIRED here: an un-sited
+  ``io_error`` keeps its checkpoint-IO-only firing so existing drills are
+  unchanged.
 * serving sites (``deepspeed_tpu/serving``, drilled by ``tools/serve_drill.py``
   the way ``tools/chaos_drill.py`` drills training): ``slow_decode`` sleeps at
   the batcher's decode dispatch, ``cache_io_error`` raises
@@ -175,6 +181,19 @@ class FaultInjector:
                 if spec.hard:
                     os._exit(spec.exit_code)
                 raise InjectedCrash(f"injected crash at checkpoint IO ({what})")
+
+    # ---- offload-swap-site faults -----------------------------------------
+    def on_swap_io(self, site: str) -> None:
+        """Hook at the offload swapper's op submission (``site``:
+        ``swap_read`` | ``swap_write``). Only ``io_error`` specs EXPLICITLY
+        pinned to a swap site fire — ``site=None`` stays checkpoint-IO-only
+        so pre-existing drills keep their semantics."""
+        for spec in self.faults:
+            if spec.kind == "io_error" \
+                    and spec.site in ("swap_read", "swap_write") \
+                    and spec.site == site and self._take(spec):
+                self._record(spec, f"offload:{site}")
+                raise InjectedIOError(f"injected swap IO failure ({site})")
 
     # ---- serving-site faults ----------------------------------------------
     def on_serving_step(self, site: str) -> None:

@@ -733,13 +733,23 @@ class DeepSpeedTpuEngine:
                 "time; it does not compose with fp16 dynamic loss scaling "
                 "(use bf16)")
         p = dict(self.config.optimizer.params) if self.config.optimizer else {}
+        aio = self.config.offload.aio
         common = dict(
             lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
             gradient_clipping=self.config.gradient_clipping,
             schedule_fn=schedule_fn,
             nvme_path=off.nvme_path if off.device == "nvme" else None,
-            aio_threads=off.buffer_count)
+            # offload.aio owns HOW bytes move; 0-threads falls back to the
+            # autotuner (when on) or the legacy buffer_count knob
+            aio_threads=(aio.threads if aio.threads > 0
+                         else (0 if aio.autotune else off.buffer_count)),
+            aio_chunk_mb=aio.chunk_mb,
+            prefetch_depth=aio.prefetch_depth,
+            aio_autotune=aio.autotune,
+            aio_autotune_cache=aio.autotune_cache,
+            aio_o_direct=aio.o_direct,
+            upload_overlap=aio.upload_overlap)
         self._offload_unscale = jax.jit(
             lambda t, d: jax.tree_util.tree_map(lambda g: g / d, t),
             out_shardings=self.grad_sharding)
@@ -1387,8 +1397,10 @@ class DeepSpeedTpuEngine:
     def shutdown(self) -> None:
         """Orderly teardown: drain in-flight async work (offload step, async
         checkpoint commits) and stop the resilience threads. Idempotent."""
-        if self._offload is not None and self._offload.overlap:
-            self._collect_offload()
+        if self._offload is not None:
+            if self._offload.overlap:
+                self._collect_offload()
+            self._offload.close()  # drain AIO + release pooled buffers
         for mgr in self._ckpt_managers.values():
             mgr.drain(raise_on_error=False)
         if self._watchdog is not None:
@@ -1469,6 +1481,15 @@ class DeepSpeedTpuEngine:
                           if self._watchdog is not None else {}),
             "faults_fired": list(get_injector().fired),
         }
+
+    def offload_report(self) -> Dict[str, Any]:
+        """The offload data path in one call (``resilience_report()``
+        sibling): tier layout, pipeline depth/overlap flags, last-step Adam
+        + upload stage timings, measured pipeline-stall fraction, and the
+        swapper's pool/bandwidth counters."""
+        if self._offload is None:
+            return {"enabled": False}
+        return {"enabled": True, **self._offload.report()}
 
     def write_resilience_report(self, out_dir: str) -> str:
         """Atomically persist ``resilience_report()`` where the elastic agent

@@ -82,9 +82,10 @@ class TestAIO:
         sw.wait()
         ra = sw.swap_in_start("a")
         rb = sw.swap_in_start("b")
-        sw.wait()
-        np.testing.assert_array_equal(ra, a)
-        np.testing.assert_array_equal(rb, b)
+        np.testing.assert_array_equal(ra.wait(), a)
+        np.testing.assert_array_equal(rb.wait(), b)
+        ra.release()
+        rb.release()
         sw.close()
 
 

@@ -1,0 +1,454 @@
+"""Overlapped offload data path (ISSUE 10): per-op AIO completion, pooled
+pinned buffers, chunked leaf IO, the depth-k optimizer pipeline, and the
+self-tuning swap configuration.
+
+Pattern: reference ``tests/unit/ops/aio`` handle tests + the swap_tensor
+pipelined-optimizer-swapper behavior contracts.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
+
+requires_native = pytest.mark.skipif(
+    not (AsyncIOBuilder().is_compatible() and CPUAdamBuilder().is_compatible()),
+    reason="g++ toolchain unavailable")
+
+
+@requires_native
+class TestPerOpCompletion:
+    def test_tickets_wait_individually_out_of_order(self, tmp_path):
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=1)
+        a = np.arange(300_000, dtype=np.float32)
+        b = np.arange(400_000, dtype=np.float32) * 3
+        ta = sw.swap_out("a", a)
+        tb = sw.swap_out("b", b)
+        tb.wait()  # waiting b does NOT require a to be complete or reaped
+        ta.wait()
+        rb = sw.swap_in_start("b")
+        ra = sw.swap_in_start("a")
+        np.testing.assert_array_equal(rb.wait(), b)  # out of submit order
+        np.testing.assert_array_equal(ra.wait(), a)
+        ra.release()
+        rb.release()
+        assert sw.pool.outstanding == 0
+        sw.close()
+
+    def test_write_does_not_fence_read(self, tmp_path):
+        """A pending writeback must not block an independent prefetch wait
+        (the old shared-barrier behavior). The read ticket completes and is
+        consumable while the write ticket is still un-reaped."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+        seed = np.arange(100_000, dtype=np.float32)
+        sw.swap_out("seed", seed).wait()
+        w = sw.swap_out("big", np.ones(2_000_000, np.float32))
+        r = sw.swap_in_start("seed")
+        np.testing.assert_array_equal(r.wait(), seed)  # before w is waited
+        r.release()
+        w.wait()
+        sw.close()
+
+    def test_barrier_honors_sticky_chunk_failure(self, tmp_path):
+        """A chunk failure reaped by poll() (native error counter already
+        decremented) must still fail the next barrier — the ticket's view is
+        dropped and its buffer returns, never a silent success."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+        a = np.arange(5000, dtype=np.float32)
+        sw.swap_out("a", a).wait()
+        r = sw.swap_in_start("a")
+        r._failed = True  # as poll() records it after reaping a bad chunk
+        with pytest.raises(IOError):
+            sw.wait()
+        assert r.wait() is None  # no garbage view
+        assert sw.pool.outstanding == 0
+        sw.close()
+
+    def test_ticket_after_barrier_is_benign(self, tmp_path):
+        """wait() (the legacy barrier) reaps everything; a later per-ticket
+        wait on a barriered op returns instead of hanging, and read views
+        are still decoded."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+        a = np.arange(5000, dtype=np.float32)
+        t = sw.swap_out("a", a)
+        sw.wait()
+        assert t.wait() is None and t.done
+        r = sw.swap_in_start("a")
+        sw.wait()
+        np.testing.assert_array_equal(r.wait(), a)
+        r.release()
+        sw.close()
+
+
+@requires_native
+class TestBufferPool:
+    def test_no_growth_under_steady_state(self, tmp_path):
+        """After warmup, a fixed working set reuses pooled buffers — zero
+        new allocations per cycle (the reference's reusable pinned swap
+        buffers)."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=1)
+        arrays = {f"t{i}": np.random.default_rng(i).normal(
+            size=(100_000 + i,)).astype(np.float32) for i in range(3)}
+        for _ in range(2):  # warmup: populate the pool at working-set width
+            tickets = [sw.swap_out(n, a) for n, a in arrays.items()]
+            for t in tickets:
+                t.wait()
+            reads = [sw.swap_in_start(n) for n in arrays]
+            for r in reads:
+                r.wait()
+                r.release()
+        baseline = sw.pool.allocations
+        for _ in range(5):
+            tickets = [sw.swap_out(n, a) for n, a in arrays.items()]
+            for t in tickets:
+                t.wait()
+            reads = [sw.swap_in_start(n) for n in arrays]
+            for r in reads:
+                r.wait()
+                r.release()
+        assert sw.pool.allocations == baseline, "pool grew in steady state"
+        assert sw.pool.reuses > 0
+        assert sw.pool.outstanding == 0
+        sw.close()
+
+    def test_same_name_inflight_aliasing_regression(self, tmp_path):
+        """Two back-to-back swap_outs of the SAME name must each own their
+        buffer: the first write's data cannot be clobbered before it lands,
+        and the final file content is the second payload."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=1)
+        first = np.full(500_000, 1.0, np.float32)
+        second = np.full(500_000, 2.0, np.float32)
+        t1 = sw.swap_out("x", first)
+        t2 = sw.swap_out("x", second)  # submitted while t1 may be queued
+        assert t1.tid != t2.tid and t1.buf is not t2.buf
+        t1.wait()
+        t2.wait()
+        # single worker → ops ran in submission order; last write wins
+        np.testing.assert_array_equal(sw.swap_in("x"), second)
+        assert sw.pool.outstanding == 0
+        sw.close()
+
+    def test_close_with_pending_ops_drains_first(self, tmp_path):
+        """close() with operations still queued must drain before
+        destroying the native handle (no use-after-free window), finish the
+        write durably, and be idempotent."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=1)
+        payloads = {f"p{i}": np.random.default_rng(i).normal(
+            size=(400_000,)).astype(np.float32) for i in range(4)}
+        for n, a in payloads.items():
+            sw.swap_out(n, a)
+        sw.close()  # pending writes still in the queue
+        assert sw.handle is None and sw.pool.outstanding == 0
+        sw.close()  # idempotent
+        sw2 = AsyncTensorSwapper(str(tmp_path), num_threads=1)
+        sw2._meta = dict(sw._meta)
+        for n, a in payloads.items():  # every file complete on disk
+            np.testing.assert_array_equal(sw2.swap_in(n), a)
+        sw2.close()
+
+
+@requires_native
+class TestChunkedIO:
+    @pytest.mark.parametrize("o_direct", [False, True])
+    def test_chunked_roundtrip_bit_exact(self, tmp_path, o_direct):
+        """A leaf larger than chunk_mb splits into many ops; the roundtrip
+        is bit-exact, including non-chunk-multiple and sub-chunk sizes."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=4, chunk_mb=1,
+                                o_direct=o_direct)
+        rng = np.random.default_rng(0)
+        shapes = [(1 << 20,),        # 4 MB = 4 chunks exactly
+                  (1_300_003,),      # ~5 MB, odd tail chunk
+                  (777,),            # sub-chunk
+                  (257, 1031)]       # 2-D, ~1 MB
+        arrays = {f"c{i}": rng.normal(size=s).astype(np.float32)
+                  for i, s in enumerate(shapes)}
+        tickets = [sw.swap_out(n, a) for n, a in arrays.items()]
+        for t in tickets:
+            t.wait()
+        big = sw.swap_in_start("c1")
+        assert len(big.op_ids) > 1 or big.done  # really chunked
+        np.testing.assert_array_equal(big.wait(), arrays["c1"])
+        big.release()
+        for n, a in arrays.items():
+            np.testing.assert_array_equal(sw.swap_in(n), a)
+        sw.close()
+
+    def test_bandwidth_stats_populate(self, tmp_path):
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=1)
+        a = np.ones(1 << 20, np.float32)
+        sw.swap_out("a", a).wait()
+        _ = sw.swap_in("a")
+        bw = sw.bandwidth()
+        assert bw["read_bytes"] == a.nbytes
+        assert bw["write_bytes"] == a.nbytes
+        assert bw["read_MBps"] > 0 and bw["write_MBps"] > 0
+        sw.close()
+
+
+@requires_native
+class TestDepthKPipeline:
+    def _params_grads(self, seed=0, leaves=6, n=4096):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        params = {f"l{i}": {"w": jnp.asarray(
+            rng.normal(size=(n // 64, 64)), jnp.float32)}
+            for i in range(leaves)}
+        import jax
+
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(
+                rng.normal(size=p.shape) * 0.01, jnp.float32), params)
+        return params, grads
+
+    def test_pipeline_matches_serial_bit_exact(self, tmp_path):
+        """Depth-k overlap is a scheduling change only: masters, moments,
+        and uploaded params must be BIT-identical to the serial path."""
+        import jax
+
+        from deepspeed_tpu.offload import HostOffloadOptimizer
+
+        params, grads = self._params_grads()
+        outs = {}
+        for label, kw in {
+            "serial": dict(prefetch_depth=0, upload_overlap=False),
+            "depth1": dict(prefetch_depth=1, upload_overlap=False),
+            "depth3+upload": dict(prefetch_depth=3, upload_overlap=True),
+        }.items():
+            opt = HostOffloadOptimizer(
+                params, lr=1e-2, nvme_path=str(tmp_path / label),
+                aio_threads=4, aio_chunk_mb=1, **kw)
+            p = params
+            for s in range(3):
+                p, skipped = opt.step(grads, p, s)
+                assert not skipped
+            outs[label] = {
+                "params": jax.tree_util.tree_map(np.asarray, p),
+                "masters": {k: v.copy() for k, v in opt.master.items()},
+                "m": {k: opt.swapper.swap_in(k + ".m")
+                      for k in opt.master},
+            }
+            opt.close()
+        for label in ("depth1", "depth3+upload"):
+            for k in outs["serial"]["masters"]:
+                np.testing.assert_array_equal(
+                    outs["serial"]["masters"][k], outs[label]["masters"][k])
+                np.testing.assert_array_equal(
+                    outs["serial"]["m"][k], outs[label]["m"][k])
+            ref = jax.tree_util.tree_leaves(outs["serial"]["params"])
+            got = jax.tree_util.tree_leaves(outs[label]["params"])
+            for a, b in zip(ref, got):
+                np.testing.assert_array_equal(a, b)
+
+    def test_pipeline_overlaps(self, tmp_path):
+        """The depth-k pipeline must measurably reduce IO stall vs serial
+        (stall fraction strictly below the serial run's on the same data)."""
+        from deepspeed_tpu.offload import HostOffloadOptimizer
+
+        params, grads = self._params_grads(leaves=8, n=1 << 16)
+        stalls = {}
+        for label, depth in (("serial", 0), ("depth3", 3)):
+            opt = HostOffloadOptimizer(
+                params, lr=1e-2, nvme_path=str(tmp_path / label),
+                aio_threads=4, aio_chunk_mb=1, prefetch_depth=depth,
+                upload_overlap=False)
+            p = params
+            for s in range(2):
+                p, _ = opt.step(grads, p, s)
+            stalls[label] = opt._stall_fraction
+            assert opt.swapper.pool.outstanding == 0
+            opt.close()
+        assert stalls["depth3"] < stalls["serial"]
+
+    def test_abort_mid_pipeline_restores_pool(self, tmp_path):
+        """An injected swap-site IO error mid-pipeline aborts cleanly: the
+        exception propagates, every pooled buffer is returned, and no
+        moment file is torn (all still readable at full size)."""
+        from deepspeed_tpu.offload import HostOffloadOptimizer
+        from deepspeed_tpu.resilience.faults import (
+            FaultInjector, set_injector)
+
+        params, grads = self._params_grads(leaves=5)
+        opt = HostOffloadOptimizer(params, lr=1e-2, nvme_path=str(tmp_path),
+                                   aio_threads=2, prefetch_depth=2,
+                                   upload_overlap=False)
+        p, _ = opt.step(grads, params, 0)  # one clean step
+        moments = {k: opt.swapper.swap_in(k + ".m") for k in opt.master}
+        set_injector(FaultInjector([
+            {"kind": "io_error", "site": "swap_read", "times": 1}]))
+        try:
+            with pytest.raises(OSError):
+                opt.step(grads, p, 1)
+        finally:
+            set_injector(None)
+        assert opt.swapper.pool.outstanding == 0
+        assert opt.swapper.pending == 0
+        for k, before in moments.items():  # no torn files
+            after = opt.swapper.swap_in(k + ".m")
+            assert after.shape == before.shape
+            assert np.isfinite(after).all()
+        opt.close()
+
+    def test_engine_config_plumbs_aio_block(self, tmp_path, eight_devices):
+        """offload.aio knobs reach the swapper + optimizer through
+        ds.initialize, and engine.offload_report() surfaces the pipeline."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import TransformerLM, get_preset
+
+        eng, *_ = ds.initialize(
+            model=TransformerLM(get_preset("tiny")),
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 2,
+                    "offload_optimizer": {"device": "nvme",
+                                          "nvme_path": str(tmp_path)}},
+                "offload": {"aio": {"threads": 3, "chunk_mb": 2,
+                                    "prefetch_depth": 4}},
+                "mesh": {"fsdp": 8},
+                "steps_per_print": 100,
+            })
+        opt = eng._offload
+        assert opt.swapper.num_threads == 3
+        assert opt.swapper.chunk_bytes == 2 << 20
+        assert opt.prefetch_depth == 4
+        b = {"input_ids": np.random.default_rng(0).integers(
+            0, 256, (2 * eng.topology.dp_world_size, 16))}
+        loss = eng.forward(b)
+        eng.backward(loss)
+        eng.step()
+        rep = eng.offload_report()
+        assert rep["enabled"] and rep["device"] == "nvme"
+        assert rep["prefetch_depth"] == 4
+        assert rep["swapper"]["pool"]["outstanding"] == 0
+        assert rep["swapper"]["read_MBps"] > 0
+        assert 0.0 <= rep["pipeline_stall_fraction"] <= 1.0
+
+    def test_offload_metrics_in_registry(self, tmp_path):
+        """offload/* instruments land in the process registry and render in
+        the Prometheus exposition."""
+        from deepspeed_tpu.observability.registry import (
+            MetricsRegistry, set_registry)
+        from deepspeed_tpu.offload import HostOffloadOptimizer
+
+        reg = set_registry(MetricsRegistry())
+        try:
+            params, grads = self._params_grads(leaves=3)
+            opt = HostOffloadOptimizer(params, lr=1e-2,
+                                       nvme_path=str(tmp_path),
+                                       prefetch_depth=2)
+            opt.step(grads, params, 0)
+            assert reg.get("offload/swap_in_ms").series
+            assert reg.get("offload/swap_out_ms").series
+            assert reg.get("offload/adam_ms").series
+            assert reg.get("offload/upload_ms").series
+            bytes_read = next(iter(
+                reg.get("offload/bytes_read").series.values())).value
+            assert bytes_read > 0
+            text = reg.render_prometheus()
+            assert "offload_swap_in_ms_bucket" in text
+            assert "offload_bytes_read_total" in text
+            assert "offload_pipeline_stall_fraction" in text
+            opt.close()
+        finally:
+            set_registry(None)
+
+
+@requires_native
+class TestAutotune:
+    def test_cache_store_and_load(self, tmp_path, monkeypatch):
+        """First autotune sweeps and stores; the second call (same device)
+        loads the cache instead of re-running the sweep."""
+        import deepspeed_tpu.ops.aio_bench as ab
+
+        calls = {"n": 0}
+        real_sweep = ab.sweep
+
+        def counting_sweep(*a, **kw):
+            calls["n"] += 1
+            return real_sweep(
+                a[0], sizes_mb=[1], threads=[1, 2], repeats=1,
+                o_direct=False, chunks_mb=[0])
+
+        monkeypatch.setattr(ab, "sweep", counting_sweep)
+        cache = str(tmp_path / "tune.json")
+        cfg1 = ab.autotune_config(str(tmp_path / "swap"), cache_path=cache)
+        assert calls["n"] == 1
+        assert cfg1["threads"] in (1, 2) and cfg1["chunk_mb"] >= 1
+        assert os.path.exists(cache)
+        with open(cache) as f:
+            stored = json.load(f)
+        assert stored[cfg1["device"]]["threads"] == cfg1["threads"]
+        cfg2 = ab.autotune_config(str(tmp_path / "swap2"), cache_path=cache)
+        assert calls["n"] == 1, "second call must hit the cache"
+        assert cfg2 == cfg1
+
+    def test_swapper_adopts_autotuned_config(self, tmp_path, monkeypatch):
+        import deepspeed_tpu.ops.aio_bench as ab
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        monkeypatch.setattr(
+            ab, "autotune_config",
+            lambda swap_dir, **kw: {"threads": 7, "chunk_mb": 3})
+        sw = AsyncTensorSwapper(str(tmp_path), autotune=True)
+        assert sw.num_threads == 7
+        assert sw.chunk_bytes == 3 << 20
+        assert sw.autotuned == {"threads": 7, "chunk_mb": 3}
+        a = np.arange(10_000, dtype=np.float32)
+        sw.swap_out("a", a).wait()
+        np.testing.assert_array_equal(sw.swap_in("a"), a)
+        sw.close()
+
+    def test_explicit_knobs_beat_autotune(self, tmp_path, monkeypatch):
+        import deepspeed_tpu.ops.aio_bench as ab
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        monkeypatch.setattr(
+            ab, "autotune_config",
+            lambda *a, **kw: {"threads": 7, "chunk_mb": 3})
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=16,
+                                autotune=True)
+        assert sw.num_threads == 2 and sw.chunk_bytes == 16 << 20
+        sw.close()
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.parametrize("scenario", ["io-error-read", "io-error-write",
+                                      "pool-steady-state"])
+def test_offload_drill_scenario(scenario, tmp_path):
+    """Exit-nonzero drill wrappers (tools/offload_drill.py): a swap-site
+    io_error mid-pipeline must abort cleanly — pool restored, no torn
+    moment files — and steady state must not grow the pool."""
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools")
+    sys.path.insert(0, tools)
+    from offload_drill import run_scenario
+
+    verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict
