@@ -43,6 +43,18 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
                                jnp.stack([s for _, s in ps]),
                                bits, w.shape[1])
 
+    def q1(w2):
+        p, s = quantize_matmul_weight(w2.astype(jnp.float32), bits=8)
+        return p, s.astype(cdt)
+
+    # one jit wrapper each, bound BEFORE the per-leaf loops: the compile
+    # cache then keys on leaf shape/dtype, so the N leaves that share a
+    # geometry trace once instead of once per leaf (a fresh jax.jit per
+    # iteration has an empty cache every time)
+    q_stacked_j = jax.jit(q_stacked)
+    q_expert_layer_j = jax.jit(jax.vmap(q1))    # over experts of one layer
+    q_head_j = jax.jit(lambda h: q2(h.astype(jnp.float32)))
+
     def q_experts(w):  # [L, E, Din, F] → (packed int8, scales) leaf pair
         """MoE expert stacks quantize to PLAIN int8 arrays (name+'_q' /
         name+'_s' leaves) rather than QuantizedWeight: the grouped
@@ -53,14 +65,7 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
         dequant has no int4 nibble-unpack it could fold for free."""
         if w.ndim != 4 or w.shape[2] % 128 or w.shape[3] % 128:
             return None
-        from deepspeed_tpu.ops.quant_matmul import quantize_matmul_weight
-
-        def q1(w2):
-            p, s = quantize_matmul_weight(w2.astype(jnp.float32), bits=8)
-            return p, s.astype(cdt)
-
-        per_layer = jax.jit(jax.vmap(q1))       # over experts of one layer
-        ps = [per_layer(w[i]) for i in range(w.shape[0])]
+        ps = [q_expert_layer_j(w[i]) for i in range(w.shape[0])]
         return (jnp.stack([p for p, _ in ps]),
                 jnp.stack([s for _, s in ps]))
 
@@ -96,15 +101,14 @@ def quantize_serving_params(params, cfg, bits: int, mesh):
                         sub[name + "_q"], sub[name + "_s"] = r
                         del sub[name]
                 else:
-                    sub[name] = jax.jit(q_stacked)(sub[name])
+                    sub[name] = q_stacked_j(sub[name])
             layers[grp] = sub
         params = {**params, "layers": layers}
         head = (params["embed"]["tokens"].T if cfg.tie_embeddings
                 else params["lm_head"])
         D, V = head.shape
         if D % 128 == 0 and V % 128 == 0:
-            packed, scales = jax.jit(lambda h: q2(h.astype(jnp.float32)))(
-                head)
+            packed, scales = q_head_j(head)
             params["lm_head_q"] = QuantizedWeight(packed, scales, bits, D)
             if not cfg.tie_embeddings:
                 # _head() prefers lm_head_q; keeping the dense head resident

@@ -273,7 +273,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()        # registry structure
         self._value_lock = threading.Lock()  # instrument updates
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  #: guarded_by: _lock
         self.created_at = time.time()
 
     # ------------------------------------------------------------------

@@ -79,12 +79,14 @@ class PinnedBufferPool:
 
     def __init__(self, max_cached: int = 32):
         self.max_cached = max_cached
-        self._free: List[PinnedBuffer] = []
+        self._free: List[PinnedBuffer] = []  #: guarded_by: _lock
         self._lock = threading.Lock()
-        self.allocations = 0     # fresh buffer allocations (growth indicator)
-        self.reuses = 0
-        self.outstanding = 0     # buffers currently held by callers
-        self.bytes_allocated = 0
+        # stats mutate on get/put from both the Adam worker and the main
+        # upload thread — count under the lock or they drift
+        self.allocations = 0     #: guarded_by: _lock
+        self.reuses = 0          #: guarded_by: _lock
+        self.outstanding = 0     #: guarded_by: _lock
+        self.bytes_allocated = 0  #: guarded_by: _lock
 
     def get(self, nbytes: int) -> PinnedBuffer:
         need = _padded(nbytes)
@@ -342,13 +344,15 @@ class AsyncTensorSwapper:
         get_injector().on_swap_io(site)
 
     def _submit_chunks(self, kind: str, path: bytes, buf: PinnedBuffer,
-                       nbytes: int) -> List[int]:
+                       nbytes: int, ids: List[int]) -> List[int]:
         """Split ``nbytes`` of ``buf`` into chunk-sized native ops at file
-        offsets; one op per chunk spreads a large leaf over all workers."""
+        offsets; one op per chunk spreads a large leaf over all workers.
+        Appends into the CALLER's ``ids`` list as each op is queued, so an
+        exception mid-loop leaves the already-submitted op ids visible to
+        the caller's cleanup (they still target ``buf``)."""
         submit = (self.lib.ds_aio_submit_pread if kind == "r"
                   else self.lib.ds_aio_submit_pwrite)
         od = 1 if self.o_direct else 0
-        ids = []
         off = 0
         while off < nbytes:
             n = min(self.chunk_bytes, nbytes - off)
@@ -356,6 +360,19 @@ class AsyncTensorSwapper:
                               ctypes.c_int64(n), ctypes.c_int64(off), od))
             off += n
         return ids
+
+    def _release_failed_submit(self, op_ids: List[int],
+                               buf: PinnedBuffer) -> None:
+        """Error path between ``pool.get`` and ticket creation: reap any
+        chunks already queued against ``buf`` before the buffer returns to
+        the pool — recycling it with ops in flight would alias live IO.
+        Never raises (callers are propagating the original failure)."""
+        try:
+            for oid in op_ids:
+                self.lib.ds_aio_wait_op(self.handle, ctypes.c_int64(oid))
+        except Exception:
+            pass
+        self.pool.put(buf)
 
     def _new_ticket(self, kind: str, name: str, op_ids: List[int],
                     buf: PinnedBuffer, nbytes: int, shape=None,
@@ -377,11 +394,19 @@ class AsyncTensorSwapper:
         nbytes = arr.nbytes
         io_bytes = _padded(nbytes) if self.o_direct else nbytes
         buf = self.pool.get(io_bytes)
-        buf.data[:nbytes] = arr.view(np.uint8).reshape(-1)
-        if io_bytes > nbytes:
-            buf.data[nbytes:io_bytes] = 0
-        ids = self._submit_chunks("w", self._path(name), buf, io_bytes)
-        return self._new_ticket("w", name, ids, buf, nbytes)
+        ids: List[int] = []
+        try:
+            buf.data[:nbytes] = arr.view(np.uint8).reshape(-1)
+            if io_bytes > nbytes:
+                buf.data[nbytes:io_bytes] = 0
+            self._submit_chunks("w", self._path(name), buf, io_bytes, ids)
+            return self._new_ticket("w", name, ids, buf, nbytes)
+        except BaseException:
+            # anything raising here (copy, submit) would otherwise leak the
+            # pooled buffer: outstanding never decremented, pool shrunk for
+            # the rest of the run
+            self._release_failed_submit(ids, buf)
+            raise
 
     def swap_in_start(self, name: str) -> SwapTicket:
         """Submit an async (chunked) read into a pooled buffer. ``wait()``
@@ -392,16 +417,25 @@ class AsyncTensorSwapper:
         nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
         io_bytes = _padded(nbytes) if self.o_direct else nbytes
         buf = self.pool.get(io_bytes)
-        ids = self._submit_chunks("r", self._path(name), buf, io_bytes)
-        return self._new_ticket("r", name, ids, buf, nbytes, shape, dtype)
+        ids: List[int] = []
+        try:
+            self._submit_chunks("r", self._path(name), buf, io_bytes, ids)
+            return self._new_ticket("r", name, ids, buf, nbytes, shape,
+                                    dtype)
+        except BaseException:
+            self._release_failed_submit(ids, buf)
+            raise
 
     def swap_in(self, name: str) -> np.ndarray:
         """Blocking read returning an owned array (buffer goes back to the
         pool before returning)."""
         t = self.swap_in_start(name)
-        view = t.wait()
-        out = np.array(view)  # owned copy — the view's buffer is recycled
-        t.release()
+        try:
+            view = t.wait()
+            out = np.array(view)  # owned copy — the view buffer recycles
+        finally:
+            if t.done:            # wait() raising already released it
+                t.release()
         return out
 
     # ------------------------------------------------------------------

@@ -118,16 +118,19 @@ class ResilienceCoordinator:
         # the pending slot is read-and-reset by decide() — lock the window
         # so a signal landing mid-decide is carried, never overwritten
         self._lock = threading.Lock()
-        self._pending_code = CONTINUE
-        self._pending_reason = ""
+        self._pending_code = CONTINUE    #: guarded_by: _lock
+        self._pending_reason = ""        #: guarded_by: _lock
         # boundaries seen, NOT global_steps: skipped steps don't advance the
         # step counter, and the interval gate must keep ticking through a
         # NaN burst or a preemption would be held forever
-        self._boundaries = 0
+        self._boundaries = 0             #: guarded_by: _lock
         self.last_decision = CONTINUE
         self.last_decision_step = -1
         self.last_reason = ""
-        self.counters: Dict[str, int] = {
+        # incremented from signal threads (SIGTERM handler, watchdog) AND
+        # the step thread: a dict-slot += is not atomic, so unguarded
+        # increments lose updates under contention
+        self.counters: Dict[str, int] = {  #: guarded_by: _lock
             "collectives": 0, "saves_agreed": 0, "aborts_agreed": 0,
             "signals_save": 0, "signals_abort": 0, "decide_latency_us": 0,
         }
@@ -136,14 +139,14 @@ class ResilienceCoordinator:
     # local signals (set from any thread: SIGTERM handler, watchdog, guard)
     # ------------------------------------------------------------------
     def signal_save(self, reason: str = "") -> None:
-        self.counters["signals_save"] += 1
         with self._lock:
+            self.counters["signals_save"] += 1
             if self._pending_code < SAVE:
                 self._pending_code, self._pending_reason = SAVE, reason
 
     def signal_abort(self, reason: str = "") -> None:
-        self.counters["signals_abort"] += 1
         with self._lock:
+            self.counters["signals_abort"] += 1
             if self._pending_code < ABORT:
                 self._pending_code, self._pending_reason = ABORT, reason
 
@@ -180,9 +183,10 @@ class ResilienceCoordinator:
             self._pending_code, self._pending_reason = CONTINUE, ""
         t0 = time.monotonic()
         agreed = self._agree(code)
-        self.counters["collectives"] += 1
-        self.counters["decide_latency_us"] += int(
-            (time.monotonic() - t0) * 1e6)
+        with self._lock:
+            self.counters["collectives"] += 1
+            self.counters["decide_latency_us"] += int(
+                (time.monotonic() - t0) * 1e6)
         self.last_decision = agreed
         self.last_decision_step = int(step)
         if agreed != CONTINUE:
@@ -197,7 +201,8 @@ class ResilienceCoordinator:
             else:
                 self.last_reason = reason or "peer signal"
             key = "saves_agreed" if agreed == SAVE else "aborts_agreed"
-            self.counters[key] += 1
+            with self._lock:
+                self.counters[key] += 1
             logger.warning(
                 f"resilience coordinator: fleet agreed "
                 f"{DECISION_NAMES[agreed]} at step {step} "
@@ -211,7 +216,9 @@ class ResilienceCoordinator:
                 "reason": self.last_reason}
 
     def report(self) -> Dict:
+        with self._lock:
+            counters = dict(self.counters)
         return {"last_decision": DECISION_NAMES[self.last_decision],
                 "last_decision_step": self.last_decision_step,
                 "last_reason": self.last_reason,
-                "counters": dict(self.counters)}
+                "counters": counters}

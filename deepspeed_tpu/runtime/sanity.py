@@ -51,17 +51,25 @@ def check_config_consistency(engine) -> None:
                                  "config digest")
 
 
+@jax.jit
+def _finite_per_leaf(ls):
+    """One fused pass: a finiteness scalar per leaf, fetched together.
+    Module-level jit so repeated integrity checks (periodic sanity, every
+    restore) hit the compile cache instead of re-tracing — the cache keys
+    on the leaf structure, which is stable for a given model."""
+    return [jnp.all(jnp.isfinite(leaf))
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+            else jnp.asarray(True)
+            for leaf in ls]
+
+
 def check_param_integrity(engine) -> None:
     """Raise on non-finite parameter leaves (a corrupted checkpoint or
     diverged restore trains NaN silently); integer leaves are skipped."""
     flat = jax.tree_util.tree_flatten_with_path(engine.params)[0]
     bad = []
     leaves = [leaf for _, leaf in flat]
-    # one fused jit pass: a scalar per leaf, fetched together
-    finite = jax.jit(lambda ls: [jnp.all(jnp.isfinite(leaf))
-                                 if jnp.issubdtype(leaf.dtype, jnp.floating)
-                                 else jnp.asarray(True)
-                                 for leaf in ls])(leaves)
+    finite = _finite_per_leaf(leaves)
     for (kp, _), ok in zip(flat, finite):
         if not bool(ok):
             bad.append(jax.tree_util.keystr(kp))

@@ -337,12 +337,13 @@ class ReplicaRouter:
         # (bounded overshoot: live routes are capped by queue+active) —
         # a live request must never lose its route, or cancel/resolve
         # would silently no-op on it
-        self._routes: Dict[int, _Route] = {}
-        self._route_order: Deque[int] = deque()
+        self._routes: Dict[int, _Route] = {}           #: guarded_by: _lock
+        self._route_order: Deque[int] = deque()        #: guarded_by: _lock
+        #: guarded_by: _lock
         self._by_loc: Dict[Tuple[str, int], int] = {}  # (replica, uid)→ruid
-        self._next_ruid = 0
+        self._next_ruid = 0                            #: guarded_by: _lock
         self._prev_sigterm = None
-        self.counters: Dict[str, int] = {
+        self.counters: Dict[str, int] = {              #: guarded_by: _lock
             "routed": 0, "failover": 0, "rejected": 0, "migrated": 0,
             "migration_failed": 0, "drains": 0,
         }
@@ -451,12 +452,24 @@ class ReplicaRouter:
                         retry_after_s=max(hint, last.retry_after_s or 0.0),
                         detail=f"all {attempts} routable replicas refused")
 
+    def _route_loc(self, ruid: int) -> Optional[Tuple[str, int]]:
+        """Snapshot (replica, uid) under the lock: a migration rewrites
+        ``route.replica``/``route.uid`` as a pair under ``_lock``, so an
+        unlocked reader could see the OLD replica with the NEW uid (or
+        race the eviction sweep) and aim its command at the wrong
+        batcher."""
+        with self._lock:
+            route = self._routes.get(ruid)
+            if route is None:
+                return None
+            return route.replica, route.uid
+
     def cancel(self, ruid: int) -> bool:
-        route = self._routes.get(ruid)
-        if route is None:
+        loc = self._route_loc(ruid)
+        if loc is None:
             return False
         try:
-            return self.replicas[route.replica].cancel(route.uid)
+            return self.replicas[loc[0]].cancel(loc[1])
         except ShedError:
             return False
 
@@ -464,14 +477,14 @@ class ReplicaRouter:
         """Terminal/current state for a router uid — follows the route
         through any migrations, so 'no admitted uid silently lost' is
         checkable at the pool level exactly like at one replica."""
-        route = self._routes.get(ruid)
-        if route is None:
+        loc = self._route_loc(ruid)
+        if loc is None:
             return None
-        rep = self.replicas[route.replica]
+        rep = self.replicas[loc[0]]
         try:
-            return rep.resolve(route.uid)
+            return rep.resolve(loc[1])
         except ShedError:
-            return rep.batcher.manager.resolve(route.uid)
+            return rep.batcher.manager.resolve(loc[1])
 
     # ------------------------------------------------------------------
     # drain + migration
@@ -540,7 +553,7 @@ class ReplicaRouter:
         with self._lock:
             return self._by_loc.get((replica, uid))
 
-    def _evict_terminal_routes(self) -> None:
+    def _evict_terminal_routes(self) -> None:  #: holds: _lock
         """Called under ``self._lock``. Drops oldest routes past the
         history cap, but ONLY terminal ones — reading the replica ledger's
         ``done`` membership is a GIL-atomic dict probe, so no cross-thread

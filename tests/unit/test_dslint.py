@@ -1,0 +1,625 @@
+"""dslint static-analysis suite (ISSUE 11).
+
+Three layers:
+
+* per-checker fixture snippets — every rule proves at least one TRUE
+  POSITIVE (the bug class it exists for) and at least one FALSE-POSITIVE
+  GUARD (the nearby-but-correct idiom it must stay quiet on);
+* baseline machinery — justification enforcement, fingerprint matching,
+  stale reporting;
+* the acceptance gates — ``python tools/dslint.py deepspeed_tpu/`` exits 0
+  against the checked-in baseline (the tier-1 repo gate), and exits
+  NONZERO when a fixture bug of each checker class is injected into a
+  scratch file.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+from dslint import ALL_CHECKERS, run  # noqa: E402
+from dslint.baseline import Baseline, BaselineError  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def lint(tmp_path, code, rules=None, name="snippet.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(code))
+    return run([str(p)], rules=rules, root=str(tmp_path))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+class TestHostSync:
+    def test_item_and_cast_inside_jit(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = float(x)          # concretizes the tracer
+                return y + x.item()   # host sync inside the trace
+        """, rules=["host-sync"])
+        assert len(fs) == 2
+        assert all(f.rule == "host-sync" for f in fs)
+
+    def test_jit_by_assignment_and_np_asarray(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            def helper(x):
+                return np.asarray(x) + 1
+
+            step = jax.jit(helper)
+        """, rules=["host-sync"])
+        assert rules_of(fs) == ["host-sync"]
+
+    def test_partial_jit_decorator(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def f(x):
+                return x.item()
+        """, rules=["host-sync"])
+        assert rules_of(fs) == ["host-sync"]
+
+    def test_hot_path_flags_and_callee_closure(self, tmp_path):
+        # file suffix + qualname matches the configured hot list, and the
+        # helper the step calls is hot by closure
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            class ContinuousBatcher:
+                def step(self):
+                    return self._emit()
+
+                def _emit(self):
+                    return np.asarray(self.logits)
+        """, rules=["host-sync"], name="serving/batcher.py")
+        assert rules_of(fs) == ["host-sync"]
+        assert fs[0].func == "ContinuousBatcher._emit"
+
+    def test_item_outside_jit_is_clean(self, tmp_path):
+        # the ISSUE's named false-positive guard
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            def summarize(arr):
+                return arr.mean().item() + float(arr[0])
+        """, rules=["host-sync"])
+        assert fs == []
+
+    def test_static_casts_inside_jit_are_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                n = int(x.shape[0]) + int(len(x.shape)) + float(1.5)
+                return x * n
+        """, rules=["host-sync"])
+        assert fs == []
+
+    def test_non_hot_file_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            class ContinuousBatcher:
+                def step(self):
+                    return np.asarray(self.logits)
+        """, rules=["host-sync"], name="somewhere_else.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+class TestLockDiscipline:
+    GUARDED = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  #: guarded_by: _lock
+
+            def bad_read(self):
+                return len(self._items)
+
+            def good_read(self):
+                with self._lock:
+                    return len(self._items)
+
+            def helper(self):  #: holds: _lock
+                return list(self._items)
+    """
+
+    def test_guarded_by_violation_and_exemptions(self, tmp_path):
+        fs = lint(tmp_path, self.GUARDED, rules=["lock-discipline"])
+        assert len(fs) == 1
+        assert fs[0].func == "Registry.bad_read"
+        # __init__ assignment, with-lock read, and #: holds: helper are
+        # all exempt — exactly one finding
+
+    def test_annotation_on_standalone_line_above(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    #: guarded_by: _lock
+                    self._q = []
+
+                def bad(self):
+                    self._q.append(1)
+        """, rules=["lock-discipline"])
+        assert len(fs) == 1 and fs[0].func == "C.bad"
+
+    def test_trailing_comment_does_not_leak_to_next_line(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []      #: guarded_by: _lock
+                    self._free = 0    # unannotated: next line must NOT bind
+
+                def fine(self):
+                    return self._free
+        """, rules=["lock-discipline"])
+        assert fs == []
+
+    def test_lock_order_inversion_reported_both_sites(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def ab(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def ba(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """, rules=["lock-discipline"])
+        assert len(fs) == 2
+        assert all("inconsistent lock order" in f.message for f in fs)
+
+    def test_consistent_lock_order_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def ab(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def ab2(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """, rules=["lock-discipline"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle
+# ---------------------------------------------------------------------------
+class TestResourceLifecycle:
+    def test_unprotected_pool_get_leaks(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Swapper:
+                def swap_out(self, arr):
+                    buf = self.pool.get(arr.nbytes)
+                    self.submit(buf, arr)        # can raise → buf leaks
+                    return self.ticket(buf)
+        """, rules=["resource-lifecycle"])
+        assert rules_of(fs) == ["resource-lifecycle"]
+
+    def test_unprotected_acquire_leaks(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Engine:
+                def attach(self, toks):
+                    blocks = self.cache.acquire(toks)
+                    self.state.wire(blocks)      # can raise → refs leak
+                    self.finish(blocks)
+        """, rules=["resource-lifecycle"])
+        assert rules_of(fs) == ["resource-lifecycle"]
+
+    def test_try_finally_release_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Swapper:
+                def swap_out(self, arr):
+                    buf = self.pool.get(arr.nbytes)
+                    try:
+                        self.submit(buf, arr)
+                    finally:
+                        self.pool.put(buf)
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
+    def test_protected_handoff_idiom_is_clean(self, tmp_path):
+        # acquire; try: handoff except: release; raise — the engine's
+        # prefix_attach pattern
+        fs = lint(tmp_path, """
+            class Engine:
+                def attach(self, toks):
+                    blocks = self.cache.acquire(toks)
+                    if not blocks:
+                        return 0
+                    try:
+                        seq = self.state.wire(blocks)
+                    except BaseException:
+                        self.allocator.free(blocks)
+                        raise
+                    return seq
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
+    def test_contextmanager_acquire_is_clean(self, tmp_path):
+        # the ISSUE's named false-positive guard: release handled by the
+        # contextmanager helper
+        fs = lint(tmp_path, """
+            class Worker:
+                def work(self, arr):
+                    with self.pool.get(arr.nbytes) as buf:
+                        self.submit(buf, arr)
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
+    def test_plain_dict_and_queue_get_are_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Router:
+                def route(self, key):
+                    route = self.table.get(key)
+                    cmd = self.inbox.get(timeout=1.0)
+                    self.handle(route, cmd)
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
+    def test_immediate_return_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pooled:
+                def lease(self, n):
+                    return self.pool.get(n)
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+class TestRecompileHazard:
+    def test_jit_and_call_in_one_expression(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def quantize(leaves):
+                return [jax.jit(lambda w: w * 2)(leaf) for leaf in leaves]
+        """, rules=["recompile-hazard"])
+        assert len(fs) >= 2         # jit-and-call AND per-element wrapper
+        assert all(f.rule == "recompile-hazard" for f in fs)
+
+    def test_jit_inside_loop(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def fit(fns, xs):
+                out = []
+                for fn in fns:
+                    g = jax.jit(fn)
+                    out.append(g(xs))
+                return out
+        """, rules=["recompile-hazard"])
+        assert rules_of(fs) == ["recompile-hazard"]
+
+    def test_unhashable_static_arg(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def build(f, x):
+                g = jax.jit(f, static_argnums=(1,))
+                return g(x, [128, 256])
+        """, rules=["recompile-hazard"])
+        assert rules_of(fs) == ["recompile-hazard"]
+        assert "unhashable" in fs[0].message
+
+    def test_bound_once_and_hashable_static_are_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            class Engine:
+                def __init__(self, model):
+                    self._step = jax.jit(model.forward,
+                                         static_argnums=(1,))
+
+                def step(self, x):
+                    return self._step(x, (128, 256))
+        """, rules=["recompile-hazard"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# control-flow
+# ---------------------------------------------------------------------------
+class TestControlFlow:
+    def test_identical_arg_self_recursion(self, tmp_path):
+        # the PR 7 _cancel_quiet delegation typo, distilled
+        fs = lint(tmp_path, """
+            class Frontend:
+                def _cancel_quiet(self, uid):
+                    try:
+                        self._cancel_quiet(uid)
+                    except Exception:
+                        pass
+        """, rules=["control-flow"])
+        assert rules_of(fs) == ["control-flow"]
+        assert "infinite recursion" in fs[0].message
+
+    def test_swallowed_base_exception_in_worker_loop(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Worker:
+                def _run(self):
+                    while not self._stop.is_set():
+                        try:
+                            self.step()
+                        except BaseException:
+                            pass
+        """, rules=["control-flow"])
+        assert rules_of(fs) == ["control-flow"]
+
+    def test_bare_except_in_loop(self, tmp_path):
+        fs = lint(tmp_path, """
+            def pump(q):
+                while True:
+                    try:
+                        q.drain()
+                    except:
+                        continue
+        """, rules=["control-flow"])
+        assert rules_of(fs) == ["control-flow"]
+
+    def test_guarded_or_progressing_recursion_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Retrier:
+                def call(self, req, attempts):
+                    if attempts > 0:
+                        return self.call(req, attempts - 1)
+                    return None
+
+            def walk(node):
+                for child in node.children:
+                    walk(child)
+        """, rules=["control-flow"])
+        assert fs == []
+
+    def test_reassigned_param_recursion_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            def drain(q):
+                q = q.next_view()
+                drain(q)
+        """, rules=["control-flow"])
+        assert fs == []
+
+    def test_exception_hygiene_guards(self, tmp_path):
+        fs = lint(tmp_path, """
+            def ok_loops(q):
+                while True:
+                    try:
+                        q.drain()
+                    except Exception:      # correct spelling: clean
+                        pass
+                    try:
+                        q.pump()
+                    except BaseException:  # re-raises: clean
+                        q.note()
+                        raise
+
+            def outside_loop(q):
+                try:
+                    q.drain()
+                except BaseException:      # not in a worker loop: clean
+                    pass
+        """, rules=["control-flow"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+class TestBaseline:
+    def test_justification_is_mandatory(self, tmp_path):
+        p = tmp_path / "bl.txt"
+        p.write_text("a.py::host-sync::f::x.item()\n")
+        with pytest.raises(BaselineError):
+            Baseline.load(str(p))
+        p.write_text("a.py::host-sync::f::x.item() --   \n")
+        with pytest.raises(BaselineError):
+            Baseline.load(str(p))
+
+    def test_fingerprint_matching_and_stale(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()
+        """, rules=["host-sync"])
+        assert len(findings) == 1
+        fp = findings[0].fingerprint
+        p = tmp_path / "bl.txt"
+        p.write_text(f"{fp} -- deliberate fixture sync\n"
+                     f"gone.py::host-sync::g::y.item() -- healed long ago\n")
+        bl = Baseline.load(str(p))
+        new, suppressed = bl.split(findings)
+        assert new == [] and len(suppressed) == 1
+        assert bl.stale_entries() == [
+            "gone.py::host-sync::g::y.item()"]
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        before = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()
+        """, rules=["host-sync"], name="a.py")
+        after = lint(tmp_path, """
+            import jax
+
+            # a new comment block pushing everything down
+            # by several lines must not break the baseline
+
+
+            @jax.jit
+            def f(x):
+                return x.item()
+        """, rules=["host-sync"], name="b.py")
+        # same fingerprint modulo the path component
+        fa = before[0].fingerprint.split("::", 1)[1]
+        fb = after[0].fingerprint.split("::", 1)[1]
+        assert fa == fb
+        assert before[0].line != after[0].line
+
+
+# ---------------------------------------------------------------------------
+# acceptance gates (CLI, subprocess — exactly what CI and humans run)
+# ---------------------------------------------------------------------------
+CLI = os.path.join(TOOLS, "dslint.py")
+
+INJECTED_BUGS = {
+    "host-sync": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """,
+    "lock-discipline": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  #: guarded_by: _lock
+
+            def bad(self):
+                self._q.append(1)
+    """,
+    "resource-lifecycle": """
+        class S:
+            def leak(self):
+                buf = self.pool.get(4096)
+                self.submit(buf)
+                return self.ticket(buf)
+    """,
+    "recompile-hazard": """
+        import jax
+
+        def per_call(f, x):
+            return jax.jit(f)(x)
+    """,
+    "control-flow": """
+        class F:
+            def cancel(self, uid):
+                try:
+                    self.cancel(uid)
+                except Exception:
+                    pass
+    """,
+}
+
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, CLI] + args, cwd=cwd,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_repo_is_clean_against_checked_in_baseline():
+    """THE tier-1 gate: the whole package vs tools/dslint_baseline.txt.
+    A new finding means fix it or add a JUSTIFIED baseline entry."""
+    res = _cli(["deepspeed_tpu/"])
+    assert res.returncode == 0, (
+        "dslint found NEW findings (fix them or add a justified baseline "
+        "entry in tools/dslint_baseline.txt):\n" + res.stdout + res.stderr)
+
+
+def test_repo_gate_runs_every_rule():
+    res = _cli(["deepspeed_tpu/", "--json"])
+    data = json.loads(res.stdout)
+    assert set(data["rules"]) == set(ALL_CHECKERS)
+    assert data["files_analyzed"] > 100
+    assert data["findings"] == []
+
+
+@pytest.mark.parametrize("rule", sorted(INJECTED_BUGS))
+def test_injected_fixture_bug_fails_the_cli(rule, tmp_path):
+    """Acceptance: one fixture bug per checker class injected into a
+    scratch file makes the CLI exit nonzero and name the rule."""
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text(textwrap.dedent(INJECTED_BUGS[rule]))
+    res = _cli([str(scratch), "--json"])
+    assert res.returncode == 1, res.stdout + res.stderr
+    data = json.loads(res.stdout)
+    assert rule in {f["rule"] for f in data["findings"]}
+
+
+def test_changed_mode_smoke():
+    """--changed analyzes only git-touched files and honors the baseline
+    (pre-commit mode). The working tree may be mid-edit here, so accept
+    clean or findings — but never a usage/crash exit."""
+    res = _cli(["--changed"])
+    assert res.returncode in (0, 1), res.stdout + res.stderr
+    assert "across" in res.stdout
+
+
+def test_unjustified_baseline_is_a_hard_error(tmp_path):
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text(textwrap.dedent(INJECTED_BUGS["host-sync"]))
+    bl = tmp_path / "bl.txt"
+    bl.write_text("x.py::host-sync::f::return x.item()\n")
+    res = _cli([str(scratch), "--baseline", str(bl)])
+    assert res.returncode == 2
+    assert "justification" in res.stderr
+
+
+def test_parse_error_is_reported_not_crashed(tmp_path):
+    scratch = tmp_path / "broken.py"
+    scratch.write_text("def f(:\n")
+    res = _cli([str(scratch), "--json"])
+    assert res.returncode == 1
+    data = json.loads(res.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["parse-error"]

@@ -623,3 +623,49 @@ def test_frontend_storm_drill(tmp_path):
 
     verdict = run_scenario("frontend-storm", workdir=str(tmp_path))
     assert verdict["ok"], verdict
+
+
+def test_router_route_reads_hold_the_lock():
+    """dslint burn-down (lock-discipline): ``cancel``/``resolve`` used to
+    probe ``_routes`` and then read ``route.replica``/``route.uid`` with NO
+    lock, racing ``submit(_ruid=...)``'s migration rewrite of that pair
+    under ``_lock`` — a torn read aims the command at the wrong replica.
+    Both now snapshot (replica, uid) via ``_route_loc`` under the lock;
+    this pins the contract with a dict proxy that asserts the lock is held
+    on every route-table probe."""
+    from deepspeed_tpu.serving.router import ReplicaRouter, _Route
+
+    class _StubReplica:
+        def __init__(self, name):
+            self.name = name
+            self.cancelled = []
+            self.resolved = []
+
+        def cancel(self, uid):
+            self.cancelled.append(uid)
+            return True
+
+        def resolve(self, uid):
+            self.resolved.append(uid)
+            return COMPLETED
+
+    rep = _StubReplica("r0")
+    router = ReplicaRouter([rep], RouterConfig())
+
+    class _LockAssertingRoutes(dict):
+        def get(self, key, default=None):
+            assert router._lock.locked(), \
+                "_routes probed outside 'with self._lock:'"
+            return super().get(key, default)
+
+    routes = _LockAssertingRoutes()
+    routes[7] = _Route("r0", 42, None)
+    router._routes = routes
+
+    assert router.cancel(7) is True
+    assert rep.cancelled == [42]
+    assert router.resolve(7) == COMPLETED
+    assert rep.resolved == [42]
+    # unknown ruids stay well-behaved through the locked path too
+    assert router.cancel(999) is False
+    assert router.resolve(999) is None

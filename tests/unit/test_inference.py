@@ -822,3 +822,34 @@ def test_init_inference_checkpoint_surfaces(tmp_path, eight_devices):
         ref = hf.generate(torch.tensor(ids), max_new_tokens=3,
                           do_sample=False).numpy()
     np.testing.assert_array_equal(out2, ref)
+
+
+def test_quantize_jit_wrapper_count_does_not_scale_with_leaves(monkeypatch):
+    """dslint burn-down (recompile-hazard): ``quantize_serving_params``
+    built ``jax.jit(q_stacked)`` INSIDE the per-leaf loop (and the head
+    lambda inline), so every leaf traced+compiled against a fresh empty
+    cache. The wrappers are now bound once before the loops — exactly
+    three ``jax.jit`` calls regardless of how many leaves quantize, and
+    same-geometry leaves share one compilation."""
+    from deepspeed_tpu.inference.quant import quantize_serving_params
+
+    model, params = TestWeightQuantServing._model()
+    dense = InferenceEngineV2(model, params=params, max_sequences=2,
+                              max_seq_len=256, block_size=32)
+    real_jit = jax.jit
+    calls = []
+
+    def counting_jit(*a, **k):
+        calls.append(a)
+        return real_jit(*a, **k)
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    q = quantize_serving_params(params, dense.cfg, 8, dense.mesh)
+    monkeypatch.undo()
+    # q_stacked + expert-layer vmap + lm-head lambda; with >3 quantizable
+    # leaves in this model, the old per-leaf jit would exceed this
+    assert len(calls) == 3, [getattr(a[0], "__name__", a[0]) for a in calls]
+    from deepspeed_tpu.models.transformer import QuantizedWeight
+    n_quant = sum(isinstance(leaf, QuantizedWeight)
+                  for leaf in jax.tree_util.tree_leaves(
+                      q, is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+    assert n_quant > len(calls)     # more leaves quantized than jits built

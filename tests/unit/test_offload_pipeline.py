@@ -452,3 +452,54 @@ def test_offload_drill_scenario(scenario, tmp_path):
 
     verdict = run_scenario(scenario, workdir=str(tmp_path))
     assert verdict["ok"], verdict
+
+
+@requires_native
+class TestSubmitFailureReclaim:
+    """dslint burn-down (resource-lifecycle): ``swap_out``/``swap_in_start``
+    did ``pool.get`` and then ran fallible work (host copy, chunk submit)
+    with no exception path returning the buffer — one submit failure
+    permanently shrank the pinned pool (``outstanding`` never decremented,
+    steady-state zero-allocation contract silently broken)."""
+
+    def test_swap_out_submit_failure_returns_buffer(self, tmp_path):
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=1)
+        arr = np.arange(65536, dtype=np.float32)
+        sw.swap_out("warm", arr).wait()        # steady state: pool warmed
+        assert sw.pool.outstanding == 0
+
+        def boom(*a, **k):
+            raise RuntimeError("submit exploded")
+        sw._submit_chunks = boom
+        with pytest.raises(RuntimeError):
+            sw.swap_out("x", arr)
+        assert sw.pool.outstanding == 0        # buffer came back
+        with pytest.raises(RuntimeError):
+            sw.swap_in_start("warm")
+        assert sw.pool.outstanding == 0
+        del sw._submit_chunks                  # restore the real method
+        np.testing.assert_array_equal(sw.swap_in("warm"), arr)
+        sw.close()
+
+    def test_partial_chunk_submit_reaps_before_recycling(self, tmp_path):
+        """An exception AFTER some chunks were queued must reap those ops
+        before the buffer re-enters the pool — recycling a buffer with IO
+        in flight aliases live data."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=1)
+        arr = np.arange((3 << 20) // 4, dtype=np.float32)  # 3 chunks
+        orig = type(sw)._submit_chunks
+
+        def partial(kind, path, buf, nbytes, ids):
+            orig(sw, kind, path, buf, min(nbytes, sw.chunk_bytes), ids)
+            raise RuntimeError("died mid-submit")
+        sw._submit_chunks = partial
+        with pytest.raises(RuntimeError):
+            sw.swap_out("p", arr)
+        assert sw.pool.outstanding == 0        # returned...
+        assert sw.pending == 0                 # ...only after the reap
+        del sw._submit_chunks
+        sw.close()

@@ -833,3 +833,36 @@ def test_e2e_crash_torn_checkpoint_recovery(tmp_path):
     assert report["skipped_steps"] == 1
     # the agent saw the same report (its respawn-vs-give-up input)
     assert res.history[1].report["checkpoint"]["load_fallbacks"] == 1
+
+
+def test_signal_counters_survive_thread_contention():
+    """dslint burn-down (lock-discipline): ``signal_save``/``signal_abort``
+    used to bump ``counters`` BEFORE taking ``_lock`` — a dict-slot ``+=``
+    is read/add/store, so concurrent signal threads (SIGTERM handler,
+    watchdog, guard) lost increments. The counters are ``guarded_by:
+    _lock`` now; under a hostile switch interval every increment must
+    land."""
+    import sys
+
+    from deepspeed_tpu.resilience.coordinator import ResilienceCoordinator
+
+    coord = ResilienceCoordinator(reduce_fn=lambda c: c)
+    n_threads, n_each = 8, 400
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)       # force preemption inside the +=
+    try:
+        def hammer():
+            for _ in range(n_each):
+                coord.signal_save("t")
+                coord.signal_abort("t")
+        ts = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert coord.counters["signals_save"] == n_threads * n_each
+    assert coord.counters["signals_abort"] == n_threads * n_each
+    # the pending escalation itself also made it through intact
+    assert coord.decide(0) == ABORT

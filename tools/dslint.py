@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""dslint CLI entry point.
+
+Usage:
+    python tools/dslint.py deepspeed_tpu/              # full run vs baseline
+    python tools/dslint.py --changed                   # pre-commit mode
+    python tools/dslint.py --json --no-baseline ...    # everything, for triage
+
+The analyzer lives in the ``tools/dslint/`` package; this wrapper only
+makes ``python tools/dslint.py`` work from anywhere by putting its own
+directory on sys.path first.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dslint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

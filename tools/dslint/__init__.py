@@ -1,0 +1,41 @@
+"""dslint — JAX- and threading-aware static analysis for deepspeed_tpu.
+
+Five checkers purpose-built for this codebase's recurring failure modes
+(see tools/dslint/checkers/ and the README "Static analysis" section):
+
+* ``host-sync``          — hidden device→host syncs in jit/hot paths
+* ``lock-discipline``    — ``#: guarded_by:`` violations + lock-order graph
+* ``resource-lifecycle`` — pool/refcount leaks on exception paths
+* ``recompile-hazard``   — per-call jax.jit wrappers, unhashable statics
+* ``control-flow``       — identical-arg self-recursion, swallowed
+                           BaseException in worker loops
+
+Programmatic use::
+
+    from dslint import run
+    findings = run(["deepspeed_tpu"])          # list[Finding]
+
+CLI: ``python tools/dslint.py [paths] [--json] [--baseline F] [--changed]``.
+"""
+
+from typing import Iterable, List, Optional
+
+from .baseline import Baseline, BaselineError, write_baseline
+from .checkers import ALL_CHECKERS, RULE_HELP
+from .cli import main
+from .core import Finding, collect_py_files, run_checkers
+
+__version__ = "0.1.0"
+
+
+def run(paths: Iterable[str], rules: Optional[Iterable[str]] = None,
+        root: str = ".") -> List[Finding]:
+    """Analyze ``paths`` with the selected ``rules`` (default: all)."""
+    selected = sorted(rules) if rules is not None else sorted(ALL_CHECKERS)
+    checkers = [ALL_CHECKERS[r]() for r in selected]
+    return run_checkers(collect_py_files(paths, root), checkers)
+
+
+__all__ = ["run", "main", "Finding", "Baseline", "BaselineError",
+           "write_baseline", "ALL_CHECKERS", "RULE_HELP",
+           "collect_py_files", "run_checkers", "__version__"]

@@ -1,0 +1,102 @@
+"""Baseline (suppression) file support.
+
+One entry per line::
+
+    <path>::<rule>::<qualname>::<normalized snippet> -- <justification>
+
+The key is the finding fingerprint — deliberately line-number-free so an
+edit elsewhere in the file does not invalidate the baseline. The
+`` -- justification`` is MANDATORY: a suppression without a written reason
+is a parse error (exit 2), which is what keeps the baseline honest — every
+entry answers "why is this not a bug?" in the file itself.
+
+An entry suppresses every finding with the same fingerprint (two identical
+snippets in one function are one decision). Entries that no longer match
+anything are reported as stale so the baseline shrinks as code heals;
+stale entries are a warning, not a failure (a fix should not force a
+lockstep baseline edit to land).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from .core import Finding
+
+
+class BaselineError(Exception):
+    pass
+
+
+class Baseline:
+    def __init__(self, entries: Dict[str, str], path: str = ""):
+        self.entries = entries          # fingerprint -> justification
+        self.path = path
+        self.matched: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        entries: Dict[str, str] = {}
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if " -- " not in line:
+                    raise BaselineError(
+                        f"{path}:{lineno}: baseline entry has no "
+                        f"' -- <justification>' (every suppression must "
+                        f"say why): {line!r}")
+                key, just = line.split(" -- ", 1)
+                key = key.strip()
+                just = just.strip()
+                if not just:
+                    raise BaselineError(
+                        f"{path}:{lineno}: empty justification")
+                if key.count("::") < 3:
+                    raise BaselineError(
+                        f"{path}:{lineno}: malformed key (want "
+                        f"path::rule::qualname::snippet): {key!r}")
+                entries[key] = just
+        return cls(entries, path)
+
+    # ------------------------------------------------------------------
+    def split(self, findings: Iterable[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, suppressed) — also records per-entry match counts."""
+        new: List[Finding] = []
+        suppressed: List[Finding] = []
+        self.matched = {k: 0 for k in self.entries}
+        for f in findings:
+            fp = f.fingerprint
+            if fp in self.entries:
+                self.matched[fp] += 1
+                suppressed.append(f)
+            else:
+                new.append(f)
+        return new, suppressed
+
+    def stale_entries(self) -> List[str]:
+        return [k for k, n in self.matched.items() if n == 0]
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   justification: str = "TODO: justify or fix") -> int:
+    """Emit a baseline seeding every current finding (deduplicated by
+    fingerprint). Written entries carry a TODO justification on purpose:
+    the file will not load until a human replaces each with a reason."""
+    seen: Dict[str, Finding] = {}
+    for f in findings:
+        seen.setdefault(f.fingerprint, f)
+    lines = ["# dslint baseline — format:",
+             "#   path::rule::qualname::snippet -- justification",
+             "# A suppression without a real justification does not load.",
+             ""]
+    for fp in sorted(seen):
+        lines.append(f"{fp} -- {justification}")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    return len(seen)

@@ -1,0 +1,34 @@
+"""Checker registry: rule name -> checker factory."""
+
+from .control_flow import ControlFlowChecker
+from .host_sync import HostSyncChecker
+from .lifecycle import ResourceLifecycleChecker
+from .locks import LockDisciplineChecker
+from .recompile import RecompileHazardChecker
+
+ALL_CHECKERS = {
+    "host-sync": HostSyncChecker,
+    "lock-discipline": LockDisciplineChecker,
+    "resource-lifecycle": ResourceLifecycleChecker,
+    "recompile-hazard": RecompileHazardChecker,
+    "control-flow": ControlFlowChecker,
+}
+
+RULE_HELP = {
+    "host-sync": ("device→host syncs (.item(), np.asarray, device_get, "
+                  "block_until_ready, float/int on traced values) inside "
+                  "@jax.jit functions and configured hot step paths"),
+    "lock-discipline": ("'#: guarded_by: <lock>' attribute accesses "
+                        "outside 'with self.<lock>:', plus a cross-file "
+                        "lock acquisition-order graph"),
+    "resource-lifecycle": ("allocate/acquire/incref/pool-get call sites "
+                           "that leak on exception paths (no try/finally, "
+                           "with, or immediate handoff)"),
+    "recompile-hazard": ("jax.jit created per call / in loops, and "
+                         "unhashable literals in static arg positions"),
+    "control-flow": ("unconditional self-recursion with identical "
+                     "arguments; bare/BaseException handlers swallowing "
+                     "interrupts inside worker loops"),
+}
+
+__all__ = ["ALL_CHECKERS", "RULE_HELP"]

@@ -1,0 +1,153 @@
+"""control-flow trap checker.
+
+**self-recursion** — a function that calls *itself* with exactly its own
+parameter list, in order, with none of those parameters reassigned
+anywhere in the body, on an unconditional path (nothing but plain
+statements / ``try`` bodies / ``with`` bodies between the ``def`` and the
+call). That is ``RecursionError`` by construction — the shape of the PR 7
+``_cancel_quiet`` bug, where a delegation typo'd into the method itself.
+Recursion guarded by an ``if``, inside a loop, in an ``except`` handler
+(retry-on-error), or with any argument changed is NOT flagged.
+
+**swallowed BaseException in worker loops** — a bare ``except:`` or
+``except BaseException:`` handler without a ``raise``, lexically inside a
+``while``/``for`` loop. A worker thread's run loop that swallows
+``SystemExit``/``KeyboardInterrupt`` can never be shut down and hides
+real faults as silent retries. ``except Exception:`` is fine (that is the
+correct spelling); re-raising handlers are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import Finding, SourceFile
+
+RULE = "control-flow"
+
+
+def _param_names(fn: ast.FunctionDef) -> Optional[List[str]]:
+    a = fn.args
+    if a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs:
+        return None  # exotic signatures: skip rather than guess
+    names = [p.arg for p in a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _reassigned(fn: ast.FunctionDef, names: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in names \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        if isinstance(node, (ast.AugAssign,)) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id in names:
+            return True
+    return False
+
+
+class ControlFlowChecker:
+    rule = RULE
+
+    # ------------------------------------------------------------------
+    def _self_recursion(self, sf: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in [n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            params = _param_names(fn)
+            if params is None:
+                continue
+            pset = set(params)
+            reassigned_checked = None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                is_self_call = (
+                    (isinstance(func, ast.Name) and func.id == fn.name)
+                    or (isinstance(func, ast.Attribute)
+                        and func.attr == fn.name
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id in ("self", "cls")))
+                if not is_self_call:
+                    continue
+                args = [a.id if isinstance(a, ast.Name) else None
+                        for a in node.args]
+                kwargs = {kw.arg: (kw.value.id
+                                   if isinstance(kw.value, ast.Name)
+                                   else None)
+                          for kw in node.keywords}
+                passed = args + [kwargs.get(p) for p in
+                                 params[len(args):]]
+                if len(passed) != len(params) \
+                        or any(p != q for p, q in zip(passed, params)):
+                    continue
+                # identical arguments — is any of them ever reassigned?
+                if reassigned_checked is None:
+                    reassigned_checked = _reassigned(fn, pset)
+                if pset and reassigned_checked:
+                    continue
+                # unconditional path check: every ancestor between the
+                # call and the def must be pass-through control flow
+                conditional = False
+                for anc in sf.iter_parents(node):
+                    if anc is fn:
+                        break
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda)):
+                        conditional = True   # nested def: different story
+                        break
+                    if isinstance(anc, (ast.If, ast.IfExp, ast.While,
+                                        ast.For, ast.ExceptHandler,
+                                        ast.Match, ast.BoolOp)):
+                        conditional = True
+                        break
+                if conditional:
+                    continue
+                out.append(sf.finding(
+                    self.rule, node,
+                    f"'{fn.name}' unconditionally calls itself with its "
+                    f"own unchanged arguments — infinite recursion "
+                    f"(delegation typo?)"))
+        return out
+
+    # ------------------------------------------------------------------
+    def _swallowed_base_exception(self, sf: SourceFile
+                                  ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for handler in [n for n in ast.walk(sf.tree)
+                        if isinstance(n, ast.ExceptHandler)]:
+            t = handler.type
+            catches_base = (
+                t is None
+                or (isinstance(t, ast.Name) and t.id == "BaseException")
+                or (isinstance(t, ast.Attribute)
+                    and t.attr == "BaseException"))
+            if not catches_base:
+                continue
+            if any(isinstance(n, ast.Raise) and n.exc is None
+                   for n in ast.walk(handler)):
+                continue  # re-raises: correct interrupt hygiene
+            in_loop = any(isinstance(anc, (ast.While, ast.For))
+                          for anc in sf.iter_parents(handler))
+            if not in_loop:
+                continue
+            spelled = "bare 'except:'" if t is None \
+                else "'except BaseException:'"
+            out.append(sf.finding(
+                self.rule, handler,
+                f"{spelled} inside a loop without re-raise swallows "
+                f"SystemExit/KeyboardInterrupt — the worker loop becomes "
+                f"unkillable and real faults turn into silent retries "
+                f"(catch Exception, or re-raise)"))
+        return out
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        return list(self._self_recursion(sf)) \
+            + list(self._swallowed_base_exception(sf))
+
+    def finish(self) -> Iterable[Finding]:
+        return []

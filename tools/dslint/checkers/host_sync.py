@@ -1,0 +1,202 @@
+"""host-sync / trace-purity checker.
+
+Two kinds of context get scanned:
+
+* **jit contexts** — function defs decorated with ``@jax.jit`` (directly or
+  via ``partial(jax.jit, ...)``), defs/methods referenced by a
+  ``jax.jit(<name>)`` call anywhere in the same file, and lambdas passed
+  straight into ``jax.jit``. Host-materialization there either breaks the
+  trace or silently constant-folds a tracer.
+* **hot paths** — a configurable list of (path-suffix, qualname) step-loop
+  functions where a host sync is *legal* but each one stalls the dispatch
+  queue; every sync must be deliberate (baseline it with a justification).
+
+Flagged inside both: ``.item()``, ``.tolist()``, ``.block_until_ready()``,
+``jax.block_until_ready``, ``jax.device_get``, ``np.asarray``/``np.array``.
+Inside jit contexts additionally ``float()/int()/bool()`` on non-constant
+arguments (host round-trip on a traced value).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import (Finding, SourceFile, call_name, dotted_name,
+                    is_jit_call, is_jit_callable)
+
+RULE = "host-sync"
+
+#: (path suffix, qualname) pairs whose bodies are step-loop hot paths.
+DEFAULT_HOT_PATHS: Tuple[Tuple[str, str], ...] = (
+    ("runtime/engine.py", "DeepSpeedTpuEngine.step"),
+    ("inference/engine_v2.py", "InferenceEngineV2.decode_batch"),
+    ("serving/batcher.py", "ContinuousBatcher.step"),
+    ("offload/optimizer.py", "HostOffloadOptimizer._run_adam"),
+    ("offload/optimizer.py", "HostOffloadOptimizer._run_adam_pipelined"),
+)
+
+#: attribute calls that force a device→host sync wherever they appear
+SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+#: dotted callables that force a sync
+SYNC_CALLS = {
+    "jax.device_get", "jax.block_until_ready",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.frombuffer", "numpy.frombuffer",
+}
+
+CASTS = {"float", "int", "bool"}
+
+
+def _is_static_expr(node: ast.expr) -> bool:
+    """Expressions whose ``float()/int()`` is trace-safe: literals, len(),
+    ``.shape`` / ``.ndim`` / ``.size`` reads, time.* reads."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name == "len" or name.startswith("time."):
+            return True
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim",
+                                                         "size"):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+class HostSyncChecker:
+    rule = RULE
+
+    def __init__(self, hot_paths: Tuple[Tuple[str, str], ...] = None):
+        self.hot_paths = (DEFAULT_HOT_PATHS if hot_paths is None
+                          else tuple(hot_paths))
+
+    # ------------------------------------------------------------------
+    def _jit_contexts(self, sf: SourceFile) -> Set[ast.AST]:
+        """Function defs / lambdas whose bodies run under a jax trace."""
+        jitted: Set[ast.AST] = set()
+        jit_target_names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if is_jit_callable(dec) or is_jit_call(dec):
+                        jitted.add(node)
+            if isinstance(node, ast.Call) and is_jit_call(node):
+                # jax.jit(target, ...) / partial(jax.jit, target, ...)
+                args = node.args
+                if dotted_name(node.func) in ("partial", "functools.partial"):
+                    args = args[1:]
+                for a in args[:1]:
+                    if isinstance(a, ast.Lambda):
+                        jitted.add(a)
+                    elif isinstance(a, ast.Name):
+                        jit_target_names.add(a.id)
+                    elif isinstance(a, ast.Attribute):
+                        jit_target_names.add(a.attr)
+                    elif isinstance(a, ast.Call):
+                        # jax.jit(vmap(f)) / jit(partial(f, ...)):
+                        # the innermost named callable is what traces
+                        for inner in a.args[:1]:
+                            if isinstance(inner, ast.Name):
+                                jit_target_names.add(inner.id)
+                            elif isinstance(inner, ast.Lambda):
+                                jitted.add(inner)
+        if jit_target_names:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name in jit_target_names:
+                    jitted.add(node)
+        return jitted
+
+    def _hot_functions(self, sf: SourceFile) -> Set[ast.AST]:
+        """Configured hot roots plus their same-file callee closure: the
+        step loop's helpers (``self._x(...)`` / bare-name calls resolved in
+        this file) are just as hot as the root that calls them."""
+        hot: Set[ast.AST] = set()
+        wanted = {q for suffix, q in self.hot_paths
+                  if sf.display_path.endswith(suffix)}
+        if not wanted:
+            return hot
+        defs: List[ast.AST] = [
+            n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        by_name: Dict[str, List[ast.AST]] = {}
+        for node in defs:
+            by_name.setdefault(node.name, []).append(node)
+            cls = sf.enclosing_class(node)
+            qual = f"{cls.name}.{node.name}" if cls else node.name
+            if qual in wanted:
+                hot.add(node)
+        frontier = list(hot)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                callee = None
+                if isinstance(func, ast.Name):
+                    callee = func.id
+                elif isinstance(func, ast.Attribute) \
+                        and isinstance(func.value, ast.Name) \
+                        and func.value.id in ("self", "cls"):
+                    callee = func.attr
+                if callee is None:
+                    continue
+                for target in by_name.get(callee, ()):
+                    if target not in hot:
+                        hot.add(target)
+                        frontier.append(target)
+        return hot
+
+    # ------------------------------------------------------------------
+    def _context_of(self, sf: SourceFile, node: ast.AST, contexts) -> bool:
+        chain = [node] + list(sf.iter_parents(node))
+        return any(anc in contexts for anc in chain)
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        jit_ctx = self._jit_contexts(sf)
+        hot_ctx = self._hot_functions(sf)
+        if not jit_ctx and not hot_ctx:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            in_jit = self._context_of(sf, node, jit_ctx)
+            in_hot = (not in_jit
+                      and self._context_of(sf, node, hot_ctx))
+            if not in_jit and not in_hot:
+                continue
+            where = "jit-traced function" if in_jit else "hot step path"
+            name = call_name(node)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_ATTRS \
+                    and not name.startswith(("time.", "queue.")):
+                out.append(sf.finding(
+                    self.rule, node,
+                    f".{node.func.attr}() forces a device→host sync "
+                    f"inside a {where}"))
+                continue
+            if name in SYNC_CALLS:
+                out.append(sf.finding(
+                    self.rule, node,
+                    f"{name}() materializes on host inside a {where}"))
+                continue
+            if in_jit and name in CASTS and node.args \
+                    and not _is_static_expr(node.args[0]):
+                out.append(sf.finding(
+                    self.rule, node,
+                    f"{name}() on a possibly-traced value inside a "
+                    f"jit-traced function (concretization / host sync)"))
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        return []

@@ -1,0 +1,141 @@
+"""resource-lifecycle checker.
+
+The PR 9/10 leak class: a pooled/refcounted resource is acquired, some
+fallible work happens, and only then is ownership handed off or the
+resource released — so any exception in between leaks it (pool buffer
+never returned, refcount never decremented, lock never released).
+
+A call is *acquire-like* when the method name is ``allocate``, ``acquire``
+or ``incref``, or the method is ``get`` on a receiver whose spelling
+contains ``pool`` (``self.pool.get(n)`` — but not ``dict.get`` /
+``queue.get``). The site is clean when any of these hold:
+
+* it is the context expression of a ``with`` (contextmanager owns release);
+* it is lexically inside a ``try`` whose ``finally`` or ``except`` bodies
+  call a release-like method (``free``/``release``/``decref``/``put``/
+  ``close``/``abort``) — the exception path restores the resource;
+* the acquire's statement is a ``return``/immediately returned — ownership
+  transfers before anything can raise;
+* nothing that can raise follows it in the function (no later calls,
+  subscripts or attribute loads before every release/handoff — approximated
+  as: no further statements containing a Call in the same function body).
+
+Everything else is a finding. Legitimate hand-off patterns (builder
+functions where the very construction of the owner can't raise) belong in
+the baseline with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, SourceFile, dotted_name
+
+RULE = "resource-lifecycle"
+
+ACQUIRE_METHODS = {"allocate", "acquire", "incref"}
+POOL_GET_RECV_HINT = "pool"
+RELEASE_METHODS = {"free", "release", "decref", "put", "close", "abort",
+                   "put_nowait"}
+
+
+def _is_acquire(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    meth = call.func.attr
+    recv = dotted_name(call.func.value).lower()
+    if meth in ACQUIRE_METHODS:
+        return True
+    if meth == "get" and POOL_GET_RECV_HINT in recv:
+        return True
+    return False
+
+
+_RELEASE_HINTS = ("release", "abort", "reclaim", "cleanup", "decref",
+                  "free")
+
+
+def _contains_release(nodes: Iterable[ast.stmt]) -> bool:
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in RELEASE_METHODS \
+                        or any(h in attr.lower() for h in _RELEASE_HINTS):
+                    return True
+    return False
+
+
+class ResourceLifecycleChecker:
+    rule = RULE
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_acquire(node)):
+                continue
+            meth = node.func.attr  # type: ignore[union-attr]
+            # (a) `with recv.acquire(...) as x:` — manager owns release
+            parent = sf.parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue
+            # direct `return recv.acquire(...)` — ownership transfers
+            if isinstance(parent, ast.Return):
+                continue
+            # (b) protected by a try whose finally/except releases
+            protected = False
+            for anc in sf.iter_parents(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+                if isinstance(anc, ast.Try):
+                    cleanup: List[ast.stmt] = list(anc.finalbody)
+                    for h in anc.handlers:
+                        cleanup.extend(h.body)
+                    if _contains_release(cleanup):
+                        protected = True
+                        break
+            if protected:
+                continue
+            # (c)/(d): walk the remaining top-level statements of the
+            # function in order. Call-free statements (index math, guards
+            # with early returns) cannot raise and are skipped; the first
+            # statement that CAN raise decides: a Try whose except/finally
+            # releases is the protected-handoff idiom (clean), a release
+            # call itself is clean, anything else means an exception there
+            # leaks the resource.
+            fn = sf.enclosing_function(node)
+            body = (fn.body if fn is not None
+                    else getattr(sf.tree, "body", []))
+            later = [s for s in body
+                     if getattr(s, "lineno", 0) > node.lineno]
+            risky = False
+            for s in later:
+                if isinstance(s, ast.Return):
+                    break            # ownership transfers to the caller
+                if isinstance(s, ast.Try):
+                    cleanup = list(s.finalbody)
+                    for h in s.handlers:
+                        cleanup.extend(h.body)
+                    if _contains_release(cleanup):
+                        break        # acquire; try: handoff except: release
+                    risky = True
+                    break
+                if _contains_release([s]):
+                    break            # released before anything fallible
+                if any(isinstance(sub, ast.Call) for sub in ast.walk(s)):
+                    risky = True
+                    break
+            if not risky:
+                continue
+            out.append(sf.finding(
+                self.rule, node,
+                f"'{meth}' result can leak: fallible work follows before "
+                f"release/handoff and no try/finally (or with-statement) "
+                f"releases it on the exception path"))
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        return []

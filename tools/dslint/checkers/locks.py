@@ -1,0 +1,215 @@
+"""lock-discipline checker.
+
+Two rules:
+
+**guarded_by** — an attribute assignment annotated
+
+    self._routes = {}   #: guarded_by: _lock
+
+declares that every *other* read/write of ``self._routes`` inside the class
+must happen lexically under ``with self._lock:`` (the annotation may also
+sit on the line directly above the assignment). Exemptions:
+
+* ``__init__`` / ``__del__`` — construction and teardown precede/outlive
+  sharing;
+* methods whose ``def`` line carries ``#: holds: _lock`` — helpers
+  documented as called-with-the-lock-held (the checker trusts, the
+  annotation documents);
+* the annotated assignment itself.
+
+**lock-order** — every lexically nested acquisition ``with self.A: ...
+with self.B:`` contributes an edge A→B to a cross-file graph keyed by
+``ClassName.attr``. If both A→B and B→A exist anywhere in the analyzed
+set, every contributing site is reported: inconsistent acquisition order
+is a deadlock waiting for the right interleaving. Lock attributes are
+recognized by a ``threading.Lock/RLock/Condition/Semaphore`` assignment or
+a ``lock``/``_cv`` name suffix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import (GUARDED_BY_RE, HOLDS_RE, Finding, SourceFile,
+                    dotted_name)
+
+RULE = "lock-discipline"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        return name.split(".")[-1] in _LOCK_CTORS
+    return False
+
+
+def _lockish(attr: str) -> bool:
+    return attr.endswith("lock") or attr.endswith("_cv") \
+        or attr.endswith("_mutex") or attr.endswith("_sem")
+
+
+class LockDisciplineChecker:
+    rule = RULE
+
+    def __init__(self):
+        # (Class.attr_a, Class.attr_b) -> list of (Finding-ready site info)
+        self._edges: Dict[Tuple[str, str], List[Finding]] = {}
+
+    # ------------------------------------------------------------------
+    # guarded_by
+    # ------------------------------------------------------------------
+    def _annotations(self, sf: SourceFile, cls: ast.ClassDef
+                     ) -> Dict[str, str]:
+        """attr -> lock attr, from ``#: guarded_by:`` comments on (or one
+        line above) ``self.X = ...`` assignments anywhere in the class."""
+        guarded: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    m = GUARDED_BY_RE.search(sf.comment(node.lineno))
+                    if m is None \
+                            and sf.line(node.lineno - 1).startswith("#"):
+                        # a comment-ONLY line directly above also binds
+                        # (trailing comments of the previous statement
+                        # must not leak onto this one)
+                        m = GUARDED_BY_RE.search(
+                            sf.comment(node.lineno - 1))
+                    if m:
+                        guarded[tgt.attr] = m.group(1)
+        return guarded
+
+    def _method_holds(self, sf: SourceFile,
+                      fn: ast.FunctionDef) -> Set[str]:
+        holds: Set[str] = set()
+        for lineno in range(fn.lineno,
+                            (fn.body[0].lineno if fn.body else fn.lineno)):
+            m = HOLDS_RE.search(sf.comment(lineno))
+            if m:
+                holds.add(m.group(1))
+        return holds
+
+    def _under_with_lock(self, sf: SourceFile, node: ast.AST,
+                         lock: str, stop: ast.AST) -> bool:
+        for anc in sf.iter_parents(node):
+            if anc is stop:
+                break
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Attribute) \
+                            and expr.attr == lock:
+                        return True
+        return False
+
+    def _check_guarded(self, sf: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            guarded = self._annotations(sf, cls)
+            if not guarded:
+                continue
+            for fn in [n for n in ast.walk(cls)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and sf.enclosing_class(n) is cls]:
+                if fn.name in ("__init__", "__del__"):
+                    continue
+                holds = self._method_holds(sf, fn)
+                for node in ast.walk(fn):
+                    if not (isinstance(node, ast.Attribute)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "self"
+                            and node.attr in guarded):
+                        continue
+                    if sf.enclosing_function(node) is not fn:
+                        continue   # nested defs judged once, as themselves
+                        # (a closure can outlive the outer with-block)
+                    lock = guarded[node.attr]
+                    if lock in holds:
+                        continue
+                    if self._under_with_lock(sf, node, lock, stop=fn):
+                        continue
+                    kind = ("write" if isinstance(node.ctx,
+                                                  (ast.Store, ast.Del))
+                            else "read")
+                    out.append(sf.finding(
+                        self.rule, node,
+                        f"self.{node.attr} is '#: guarded_by: {lock}' but "
+                        f"this {kind} is outside 'with self.{lock}:' "
+                        f"(annotate the method '#: holds: {lock}' if the "
+                        f"caller owns the lock)"))
+        return out
+
+    # ------------------------------------------------------------------
+    # lock-order graph
+    # ------------------------------------------------------------------
+    def _lock_attrs(self, sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
+        attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and _is_lock_ctor(node.value):
+                        attrs.add(tgt.attr)
+        return attrs
+
+    def _collect_order_edges(self, sf: SourceFile) -> None:
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            known = self._lock_attrs(sf, cls)
+
+            def lock_of(withnode: ast.With) -> Optional[str]:
+                for item in withnode.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Attribute) and \
+                            (expr.attr in known or _lockish(expr.attr)):
+                        return expr.attr
+                return None
+
+            for outer in [n for n in ast.walk(cls)
+                          if isinstance(n, ast.With)]:
+                a = lock_of(outer)
+                if a is None:
+                    continue
+                for inner in [n for n in ast.walk(outer)
+                              if isinstance(n, ast.With) and n is not outer]:
+                    b = lock_of(inner)
+                    if b is None or b == a:
+                        continue
+                    key = (f"{cls.name}.{a}", f"{cls.name}.{b}")
+                    self._edges.setdefault(key, []).append(sf.finding(
+                        self.rule, inner,
+                        f"acquires {key[1]} while holding {key[0]}"))
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        out = list(self._check_guarded(sf))
+        self._collect_order_edges(sf)
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for (a, b), sites in sorted(self._edges.items()):
+            if a < b and (b, a) in self._edges:
+                rev = self._edges[(b, a)]
+                for f in sites + rev:
+                    out.append(Finding(
+                        rule=self.rule, path=f.path, line=f.line,
+                        col=f.col, func=f.func,
+                        message=(f"inconsistent lock order: both {a}→{b} "
+                                 f"and {b}→{a} acquisitions exist "
+                                 f"(potential deadlock); {f.message}"),
+                        snippet=f.snippet))
+        self._edges.clear()
+        return out

@@ -1,0 +1,146 @@
+"""recompile-hazard checker.
+
+``jax.jit`` caches compiled executables on the *identity* of the wrapped
+callable plus hashes of static arguments. Three patterns silently defeat
+the cache or blow up at call time:
+
+* **jit-and-call** — ``jax.jit(f)(x)``: the wrapper is created, used once
+  and dropped; every execution re-traces and re-compiles.
+* **jit-in-loop** — ``for ...: g = jax.jit(f)``: a fresh wrapper (fresh
+  cache) per iteration, even when ``f`` is loop-invariant.
+* **unhashable static** — a callable jitted with ``static_argnums``/
+  ``static_argnames`` that is later called (same scope) with a ``list``/
+  ``dict``/``set`` display in a static position: ``TypeError: unhashable``
+  at best, a per-call recompile via a workaround wrapper at worst.
+
+A jit whose result is bound to ``self.<attr>`` inside ``__init__`` (or any
+method — memoized on the instance) is the idiomatic fix and is never
+flagged by the loop rule unless the binding really is per-iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding, SourceFile, dotted_name, is_jit_call
+
+RULE = "recompile-hazard"
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+def _static_spec(call: ast.Call) -> Optional[Tuple[Tuple[int, ...],
+                                                   Tuple[str, ...]]]:
+    """(static positions, static names) from a jax.jit(...) call, or None
+    when not statically resolvable."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.append(e.value)
+                else:
+                    return None
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+                else:
+                    return None
+    if not nums and not names:
+        return None
+    return tuple(nums), tuple(names)
+
+
+class RecompileHazardChecker:
+    rule = RULE
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # name -> static spec, for jitted callables bound in this file
+        jitted_static: Dict[str, Tuple[Tuple[int, ...],
+                                       Tuple[str, ...]]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and is_jit_call(node):
+                # jit-and-call: jax.jit(f)(x)
+                parent = sf.parents.get(node)
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    out.append(sf.finding(
+                        self.rule, node,
+                        "jax.jit(...) created and invoked in one "
+                        "expression: the compiled wrapper is dropped "
+                        "after the call, so EVERY call re-traces and "
+                        "re-compiles — bind the jitted callable once and "
+                        "reuse it"))
+                # jit-in-loop
+                for anc in sf.iter_parents(node):
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda)):
+                        break
+                    if isinstance(anc, (ast.For, ast.While, ast.comprehension)):
+                        out.append(sf.finding(
+                            self.rule, node,
+                            "jax.jit(...) inside a loop builds a fresh "
+                            "wrapper (fresh compile cache) per iteration "
+                            "— hoist it out of the loop"))
+                        break
+                # comprehension bodies: ListComp/GeneratorExp ancestors
+                for anc in sf.iter_parents(node):
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        break
+                    if isinstance(anc, (ast.ListComp, ast.SetComp,
+                                        ast.DictComp, ast.GeneratorExp)):
+                        out.append(sf.finding(
+                            self.rule, node,
+                            "jax.jit(...) inside a comprehension builds a "
+                            "fresh wrapper per element — hoist it out"))
+                        break
+                # record static specs for call-site hashability checks
+                spec = _static_spec(node)
+                if spec is not None:
+                    parent = sf.parents.get(node)
+                    if isinstance(parent, ast.Assign):
+                        for tgt in parent.targets:
+                            if isinstance(tgt, ast.Name):
+                                jitted_static[tgt.id] = spec
+                            elif isinstance(tgt, ast.Attribute):
+                                jitted_static[dotted_name(tgt)] = spec
+        if jitted_static:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                spec = jitted_static.get(fname)
+                if spec is None:
+                    continue
+                nums, names = spec
+                for i in nums:
+                    if i < len(node.args) and isinstance(
+                            node.args[i], _UNHASHABLE):
+                        out.append(sf.finding(
+                            self.rule, node.args[i],
+                            f"unhashable literal passed in static arg "
+                            f"position {i} of jitted '{fname}' "
+                            f"(static args are hashed for the compile "
+                            f"cache — pass a tuple or hashable config)"))
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(kw.value,
+                                                      _UNHASHABLE):
+                        out.append(sf.finding(
+                            self.rule, kw.value,
+                            f"unhashable literal passed for static arg "
+                            f"'{kw.arg}' of jitted '{fname}'"))
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        return []

@@ -1,0 +1,191 @@
+"""dslint command line.
+
+Exit codes: 0 clean (after baseline), 1 findings, 2 usage/internal error.
+
+``--changed`` analyzes only files touched vs a git revision (default
+``HEAD``) plus staged and untracked .py files — the pre-commit mode, a few
+milliseconds instead of the whole package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from .baseline import Baseline, BaselineError, write_baseline
+from .checkers import ALL_CHECKERS, RULE_HELP
+from .core import collect_py_files, run_checkers
+
+DEFAULT_BASELINE = "tools/dslint_baseline.txt"
+
+
+def repo_root(start: str = ".") -> str:
+    """Nearest ancestor containing .git (falls back to cwd)."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, ".git")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def changed_files(root: str, base: str) -> List[str]:
+    """Changed-vs-``base`` + staged + untracked python files."""
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", base],
+                ["git", "diff", "--name-only", "--cached"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, check=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"dslint: --changed needs git: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        out.extend(line.strip() for line in res.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    seen, uniq = set(), []
+    for p in out:
+        ap = os.path.join(root, p)
+        if p not in seen and os.path.exists(ap):
+            seen.add(p)
+            uniq.append(ap)
+    return uniq
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dslint",
+        description="JAX- and threading-aware static analysis for this "
+                    "codebase's recurring failure modes.")
+    p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                   help="files or directories (default: deepspeed_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"suppression file (default: {DEFAULT_BASELINE} "
+                        f"at the repo root, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report everything")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write current findings as a TODO-justified "
+                        "baseline and exit")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REV",
+                   help="only analyze files changed vs REV (default HEAD) "
+                        "plus staged/untracked — pre-commit mode")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rules to run "
+                        f"(of: {','.join(sorted(ALL_CHECKERS))})")
+    p.add_argument("--ignore", default=None, metavar="RULES",
+                   help="comma-separated rules to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the checker catalogue and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(ALL_CHECKERS):
+            print(f"{rule}\n    {RULE_HELP[rule]}")
+        return 0
+
+    rules = set(ALL_CHECKERS)
+    if args.select:
+        rules = {r.strip() for r in args.select.split(",") if r.strip()}
+    if args.ignore:
+        rules -= {r.strip() for r in args.ignore.split(",") if r.strip()}
+    unknown = rules - set(ALL_CHECKERS)
+    if unknown:
+        print(f"dslint: unknown rule(s): {', '.join(sorted(unknown))}",
+              file=sys.stderr)
+        return 2
+    checkers = [ALL_CHECKERS[r]() for r in sorted(rules)]
+
+    # anchor the repo root on the first analyzed path, not the cwd: display
+    # paths (= baseline keys) must be repo-relative no matter where the
+    # tool is invoked from
+    anchor = next((p for p in args.paths if os.path.exists(p)), ".")
+    root = repo_root(anchor if os.path.isdir(anchor)
+                     else os.path.dirname(os.path.abspath(anchor)) or ".")
+    if args.changed is not None:
+        files = changed_files(root, args.changed)
+        # scope the changed set to the requested paths — resolving relative
+        # entries against the detected repo ROOT, not the cwd (running from
+        # a subdirectory must not silently filter everything out)
+        prefixes = [p if os.path.isabs(p) else os.path.join(root, p)
+                    for p in args.paths]
+        prefixes = [os.path.abspath(p) for p in prefixes]
+        files = [f for f in files
+                 if any(os.path.abspath(f).startswith(pre + os.sep)
+                        or os.path.abspath(f) == pre for pre in prefixes)]
+        pairs = collect_py_files(files, root)
+    else:
+        pairs = collect_py_files(args.paths, root)
+
+    findings = run_checkers(pairs, checkers)
+
+    if args.write_baseline:
+        n = write_baseline(args.write_baseline, findings)
+        print(f"dslint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {args.write_baseline} "
+              f"(replace each TODO with a real justification)")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        bp = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+        if os.path.exists(bp):
+            try:
+                baseline = Baseline.load(bp)
+            except BaselineError as e:
+                print(f"dslint: {e}", file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"dslint: baseline not found: {bp}", file=sys.stderr)
+            return 2
+
+    suppressed = []
+    stale: List[str] = []
+    if baseline is not None:
+        findings, suppressed = baseline.split(findings)
+        # an entry is only provably stale when its file WAS analyzed this
+        # run (--changed / partial-path runs must not cry wolf)
+        analyzed = {disp for _, disp in pairs}
+        stale = [k for k in baseline.stale_entries()
+                 if k.split("::", 1)[0] in analyzed]
+
+    if args.as_json:
+        print(json.dumps({
+            "files_analyzed": len(pairs),
+            "rules": sorted(rules),
+            "findings": [f.to_json() for f in findings],
+            "suppressed": len(suppressed),
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = (f"dslint: {len(findings)} finding"
+                f"{'' if len(findings) == 1 else 's'} "
+                f"({len(suppressed)} baselined) across {len(pairs)} files")
+        if stale:
+            tail += (f"; {len(stale)} STALE baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} "
+                     f"(fixed or drifted — prune them):")
+            print(tail)
+            for k in stale:
+                print(f"    stale: {k}")
+        else:
+            print(tail)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""dslint core: source loading, AST plumbing, and the Finding model.
+
+The suite is stdlib-only (``ast`` + ``tokenize``) on purpose: it runs as a
+tier-1-collected test on every CI pass, so it must import nothing the
+container may lack and finish in seconds over the whole package.
+
+Checkers are small classes with two hooks:
+
+* ``check_file(sf)``  — per-file findings (most rules);
+* ``finish()``        — cross-file findings after every file was visited
+  (the lock-order graph is the only current user).
+
+Findings are keyed for baseline matching by a *line-number-free*
+fingerprint — ``path::rule::qualname::normalized-snippet`` — so an edit
+elsewhere in a file does not invalidate the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: structured-comment annotations the lock checker understands
+GUARDED_BY_RE = re.compile(r"#:\s*guarded_by:\s*(\w+)")
+HOLDS_RE = re.compile(r"#:\s*holds:\s*(\w+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str           # repo-relative, posix separators
+    line: int
+    col: int
+    func: str           # enclosing qualname, or "<module>"
+    message: str
+    snippet: str        # stripped source line
+
+    @property
+    def fingerprint(self) -> str:
+        return "::".join((self.path, self.rule, self.func,
+                          normalize_snippet(self.snippet)))
+
+    def to_json(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "func": self.func, "message": self.message,
+                "snippet": self.snippet, "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: [{self.rule}] "
+                f"{self.message}\n    in {self.func}: {self.snippet}")
+
+
+def normalize_snippet(snippet: str) -> str:
+    return " ".join(snippet.split())
+
+
+class SourceFile:
+    """One parsed module: AST with parent links, raw lines, per-line
+    comments (via ``tokenize`` so ``#`` inside strings never confuses the
+    annotation scan)."""
+
+    def __init__(self, path: str, display_path: str, text: str):
+        self.path = path
+        self.display_path = display_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:   # unterminated multiline at EOF etc.
+            pass
+
+    # ------------------------------------------------------------------
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def comment(self, lineno: int) -> str:
+        return self.comments.get(lineno, "")
+
+    def iter_parents(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Qualified name of the innermost function enclosing ``node``
+        (``Class.method`` / ``outer.<locals>.inner``), or ``<module>``."""
+        names: List[str] = []
+        chain = [node] + list(self.iter_parents(node))
+        for anc in chain:
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(anc.name)
+        if not names:
+            return "<module>"
+        return ".".join(reversed(names))
+
+    def enclosing_function(self, node: ast.AST
+                           ) -> Optional[ast.FunctionDef]:
+        for anc in self.iter_parents(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.iter_parents(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.display_path, line=lineno,
+                       col=col, func=self.qualname(node), message=message,
+                       snippet=self.line(lineno))
+
+
+# ----------------------------------------------------------------------
+# shared AST helpers
+# ----------------------------------------------------------------------
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted source text of a Name/Attribute chain
+    (``jax.numpy.asarray`` → that string; anything else → "")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """True for expressions that name ``jax.jit`` (or a bare ``jit`` /
+    ``api.jit`` import alias)."""
+    name = dotted_name(node)
+    return name == "jit" or name.endswith(".jit")
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` — including ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if is_jit_callable(node.func):
+        return True
+    fname = dotted_name(node.func)
+    if fname in ("partial", "functools.partial") and node.args:
+        return is_jit_callable(node.args[0])
+    return False
+
+
+# ----------------------------------------------------------------------
+# orchestration
+# ----------------------------------------------------------------------
+def collect_py_files(paths: Iterable[str], root: str) -> List[Tuple[str, str]]:
+    """Expand files/directories into (abs_path, display_path) pairs.
+    display paths are relative to ``root`` when possible (stable baseline
+    keys regardless of invocation cwd)."""
+    out: List[Tuple[str, str]] = []
+    seen = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif ap.endswith(".py") and os.path.exists(ap):
+            out.append(ap)
+    pairs = []
+    for ap in out:
+        if ap in seen:
+            continue
+        seen.add(ap)
+        try:
+            rel = os.path.relpath(ap, root)
+        except ValueError:
+            rel = ap
+        disp = rel if not rel.startswith("..") else ap
+        pairs.append((ap, disp.replace(os.sep, "/")))
+    return pairs
+
+
+def run_checkers(pairs: List[Tuple[str, str]], checkers) -> List[Finding]:
+    findings: List[Finding] = []
+    for ap, disp in pairs:
+        try:
+            with open(ap, "r", encoding="utf-8") as f:
+                text = f.read()
+            sf = SourceFile(ap, disp, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="parse-error", path=disp, line=e.lineno or 1,
+                col=e.offset or 0, func="<module>",
+                message=f"file does not parse: {e.msg}", snippet=""))
+            continue
+        except OSError as e:
+            findings.append(Finding(
+                rule="parse-error", path=disp, line=1, col=0,
+                func="<module>", message=f"cannot read file: {e}",
+                snippet=""))
+            continue
+        for checker in checkers:
+            findings.extend(checker.check_file(sf))
+    for checker in checkers:
+        findings.extend(checker.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
