@@ -469,7 +469,9 @@ def run_inference_bench(cfg=None,
     ctx_pc = prompt + 16 + 6 * spec_steps + 8   # 6 decode rounds below
     eng = InferenceEngineV2(
         model, params=params, max_sequences=4,
-        max_seq_len=ctx_pc, block_size=bs_pc, prefix_cache=True,
+        max_seq_len=ctx_pc, block_size=bs_pc,
+        prefix_cache={"enabled": True,
+                      "tiers": {"enabled": True, "host_mb": 64.0}},
         speculative={"enabled": True, "ngram": 2, "max_draft": 4,
                      "fallback_steps": 4})
     shared = rng.integers(0, cfg.vocab_size, prompt)
@@ -522,6 +524,57 @@ def run_inference_bench(cfg=None,
         cur = int(out[104][-1])
     s1 = eng.spec_stats
     rounds = max(1, s1["rounds"] - s0["rounds"])
+    eng.flush([104])
+    # ---- tiered KV: host-tier warm TTFT vs cold recompute ---------------
+    # (the "nearly free" claim as a number: demote the published shared
+    # blocks to pinned host DRAM, then re-serve the same ~94%-cached
+    # prompt shape — the hit is an async promote + suffix prefill instead
+    # of a full prefill. A dedicated engine with a LONGER shared prefix:
+    # the promote cost is a fixed handful of dispatches, so the prompt
+    # must be long enough that recompute is the thing being saved —
+    # 4x the bench prompt, matching a realistic system-prompt share.)
+    tp = ((4 * prompt) // bs_pc) * bs_pc
+    teng = InferenceEngineV2(
+        model, params=params, max_sequences=2, max_seq_len=tp + 32,
+        block_size=bs_pc,
+        prefix_cache={"enabled": True,
+                      "tiers": {"enabled": True, "host_mb": 64.0}})
+    shared_t = rng.integers(0, cfg.vocab_size, tp)
+    tsfx = [rng.integers(0, cfg.vocab_size, 16) for _ in range(4)]
+
+    def tier_put(uid, suffix):
+        t0 = time.perf_counter()
+        teng.put([uid], [np.concatenate([shared_t, suffix])])
+        return (time.perf_counter() - t0) * 1e3
+
+    tpc = teng.prefix_cache
+    tier_put(200, tsfx[0])                 # cold-path compile + publish
+    teng.flush([200])
+    tpc.evict(tpc.evictable_blocks())      # demote everything -> host
+    tier_put(201, tsfx[1])                 # warm-path + promote compile
+    teng.flush([201])
+    tpc.evict(tpc.evictable_blocks())      # demote again
+    host_ms = tier_put(202, tsfx[2])       # timed: host-tier promote
+    teng.flush([202])
+    tier_counters = tpc.report().get("tiers", {})
+    promoted_blocks = tpc.report()["promoted_blocks"]
+    tpc.clear()                            # 0% resident: recompute
+    cold2_ms = tier_put(203, tsfx[3])
+    teng.flush([203])
+    tier = {
+        "prompt_tokens": int(tp + 16),
+        "cached_prefix_tokens": int(tp),
+        "host_warm_ttft_put_ms": round(host_ms, 2),
+        "cold_recompute_ttft_ms": round(cold2_ms, 2),
+        "host_vs_cold_speedup": round(cold2_ms / max(host_ms, 1e-9), 2),
+        "hits": {t: tier_counters.get(f"{t}_hits", 0)
+                 for t in ("host", "nvme")},
+        "demotions": {t: tier_counters.get(f"{t}_demotions", 0)
+                      for t in ("host", "nvme")},
+        "promoted_blocks": promoted_blocks,
+    }
+    teng.close()
+    del teng
     prefix_spec = {
         "block_size": bs_pc,
         "prompt_tokens": int(len(shared) + 16),
@@ -537,8 +590,9 @@ def run_inference_bench(cfg=None,
             (s1["emitted"] - s0["emitted"]) / rounds, 2),
         "accepted_per_round": round(
             (s1["accepted"] - s0["accepted"]) / rounds, 2),
+        "tier": tier,
     }
-    eng.flush([104])
+    eng.close()
 
     return {
         "decode": decode,

@@ -626,19 +626,54 @@ class ServingConfig(DSTpuConfigModel):
         return self
 
 
+class KVTierConfig(DSTpuConfigModel):
+    """``inference.prefix_cache.tiers``: spill the prefix cache past HBM —
+    instead of freeing an LRU rc==1 cache block, demote its KV pages to a
+    pinned host buffer (:class:`~deepspeed_tpu.offload.swap.
+    PinnedBufferPool` client), and under host-pool pressure on to NVMe via
+    the per-op AIO ticket path (``offload/swap.py``). A radix match landing
+    on a demoted block promotes it back asynchronously, overlapped under
+    the step's host-side batch building — ZeRO-Infinity's HBM↔host↔NVMe
+    discipline turned onto the serving pool, so cache capacity stops being
+    an HBM problem."""
+
+    enabled: bool = False
+    # pinned host budget for demoted KV pages (float so tests/drills can
+    # size it in fractions of a MB — tiny-model blocks are ~16 KB)
+    host_mb: float = 64.0
+    # "" = host tier only; a path enables the NVMe tier (KV pages live
+    # under <nvme_path>/kv, the swapper's KV namespace)
+    nvme_path: str = ""
+    # max NVMe promote reads in flight at once; further promotes submit
+    # lazily at the fence so one giant warm prefix cannot monopolize the
+    # AIO threadpool mid-step
+    promote_depth: int = 4
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.host_mb <= 0:
+            raise ValueError(
+                "inference.prefix_cache.tiers.host_mb must be > 0")
+        if self.promote_depth < 1:
+            raise ValueError(
+                "inference.prefix_cache.tiers.promote_depth must be >= 1")
+        return self
+
+
 class PrefixCacheConfig(DSTpuConfigModel):
     """``inference.prefix_cache``: cross-request KV reuse over the paged
     block pool (``deepspeed_tpu/inference/ragged.py`` :class:`PrefixCache`)
     — a radix tree of full-block token chunks lets a request whose prompt
     repeats a resident prefix attach those blocks and prefill only the
     uncached suffix. Blocks held only by the tree are evicted LRU under
-    pool pressure; blocks a live sequence shares are never evicted or
-    written through."""
+    pool pressure (or demoted to host/NVMe when ``tiers`` is enabled);
+    blocks a live sequence shares are never evicted or written through."""
 
     enabled: bool = False
     # cap on tree-held blocks (None = bounded by the pool itself, with LRU
     # reclaim whenever live sequences need the space)
     max_blocks: Optional[int] = None
+    tiers: KVTierConfig = Field(default_factory=KVTierConfig)
 
     @model_validator(mode="after")
     def _check(self):

@@ -7,6 +7,8 @@ allocator, ragged step).
 
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2  # noqa: F401
+from deepspeed_tpu.inference.kv_tier import KVTierStore  # noqa: F401
 from deepspeed_tpu.inference.ragged import (BlockedAllocator, CapacityError,  # noqa: F401
-                                            PrefixCache, SequenceManager)
+                                            PrefixCache, PromoteRecord,
+                                            SequenceManager)
 from deepspeed_tpu.inference.speculative import ngram_draft  # noqa: F401

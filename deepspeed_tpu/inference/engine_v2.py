@@ -142,6 +142,7 @@ class InferenceEngineV2:
             # and retrace/recompile every step program once per alternation
             kv_out = {k: NamedSharding(self.mesh, s)
                       for k, s in cache_spec.items()}
+            self._kv_out = kv_out       # reused by the tier-promote scatter
             # donate the pool: the step returns the updated {'k','v'} dict and
             # self.cache is immediately reassigned — without donation XLA would
             # double-buffer the whole pool and copy all unchanged blocks
@@ -184,6 +185,12 @@ class InferenceEngineV2:
             raise ValueError("prefix_cache / speculative need the packed "
                              "paged engine (paged=True, packed=True)")
         self.prefix_cache: Optional[PrefixCache] = None
+        # tiered KV spill state (inference.prefix_cache.tiers): the store
+        # holding demoted blocks' pages, the queue of promotions awaiting
+        # their device upload, and the per-tier promote-latency histograms
+        self._tier_store = None
+        self._promote_q: list = []
+        self._promote_ms = None
         if self.prefix_cfg.enabled:
             from deepspeed_tpu.observability import get_registry
 
@@ -202,10 +209,54 @@ class InferenceEngineV2:
                 "blocks": r.gauge("inference/prefix_cache_blocks",
                                   "blocks currently held by the prefix tree"),
             }
+            tiers = self.prefix_cfg.tiers
+            if tiers.enabled:
+                inst["tier_hits_hbm"] = r.counter(
+                    "inference/prefix_cache_tier_hits",
+                    "cached blocks served per tier on a radix match",
+                    labels={"tier": "hbm"})
             self.prefix_cache = PrefixCache(
                 self.state.allocator, max_blocks=self.prefix_cfg.max_blocks,
                 instruments=inst)
             self.state.prefix_cache = self.prefix_cache
+            if tiers.enabled:
+                from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+                tier_inst = {}
+                for t in ("host", "nvme"):
+                    tier_inst[t] = {
+                        "hits": r.counter(
+                            "inference/prefix_cache_tier_hits",
+                            "cached blocks served per tier on a radix match",
+                            labels={"tier": t}),
+                        "misses": r.counter(
+                            "inference/prefix_cache_tier_misses",
+                            "tier entries lost or unreadable (recomputed)",
+                            labels={"tier": t}),
+                        "demotions": r.counter(
+                            "inference/prefix_cache_tier_demotions",
+                            "cache blocks demoted into the tier",
+                            labels={"tier": t}),
+                        "bytes": r.gauge(
+                            "inference/prefix_cache_tier_bytes",
+                            "KV bytes resident in the tier",
+                            labels={"tier": t}),
+                    }
+                self._promote_ms = {
+                    t: r.histogram(
+                        "inference/prefix_cache_tier_promote_ms",
+                        "demoted-block promote latency: tier fetch start "
+                        "to pool upload dispatched", labels={"tier": t})
+                    for t in ("host", "nvme")}
+                self._tier_store = KVTierStore(
+                    host_mb=tiers.host_mb, nvme_path=tiers.nvme_path,
+                    promote_depth=tiers.promote_depth,
+                    instruments=tier_inst)
+                self.prefix_cache.attach_tier_store(self._tier_store,
+                                                    self._extract_blocks)
+                self._promote_step = jax.jit(self._promote_impl,
+                                             donate_argnums=(0,),
+                                             out_shardings=self._kv_out)
         # per-uid committed-token history: needed to key prefix publication
         # and to self-draft n-grams; None when both features are off so the
         # hot path pays nothing
@@ -275,16 +326,26 @@ class InferenceEngineV2:
         if len(toks) < 2:
             return 0
         blocks, n = self.prefix_cache.acquire(toks, max_tokens=len(toks) - 1)
-        if n == 0:
-            return 0
+        recs: list = []
         try:
+            # collect any promotions this acquire started, whatever
+            # happens next: their uploads fence at the next device
+            # dispatch, and an attach failure must re-demote them (their
+            # pool blocks hold garbage until uploaded)
+            recs = self.prefix_cache.drain_promotes()
+            if n == 0:
+                return 0
             seq = self.state.attach_prefix(uid, blocks, n)
         except BaseException:
             # slot exhaustion (or any attach failure): give back acquire's
             # references before surfacing — leaked refs would pin the
             # blocks (refcount >= 2) out of the evictable set forever
-            self.state.allocator.free(blocks)
+            if blocks:
+                self.state.allocator.free(blocks)
+            if recs:
+                self.prefix_cache.cancel_promotes(recs)
             raise
+        self._promote_q.extend(recs)
         self._pos[seq.slot] = n
         if self._hist is not None:
             self._hist[uid] = toks[:n].copy()
@@ -314,6 +375,149 @@ class InferenceEngineV2:
     def prefix_cache_report(self) -> Optional[Dict]:
         return (None if self.prefix_cache is None
                 else self.prefix_cache.report())
+
+    # ---- tiered KV spill (inference.prefix_cache.tiers) ------------------
+    def _extract_blocks(self, blocks: Sequence[int]) -> list:
+        """Fetch the listed pool blocks' KV pages to host in ONE gather +
+        one transfer (the demote path's device read; per-block fetches
+        would pay a dispatch round-trip each). Returns one
+        ``{part: ndarray}`` payload per block."""
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        grab = {"k": self.cache["k"][:, idx], "v": self.cache["v"][:, idx]}
+        if "kv_scale" in self.cache:
+            grab["kv_scale"] = self.cache["kv_scale"][:, idx]
+        pages = jax.device_get(grab)
+        return [{name: arr[:, i] for name, arr in pages.items()}
+                for i in range(len(blocks))]
+
+    def _promote_impl(self, cache, idx, kp, vp, sp=None):
+        """One scatter folds every pending promote's pages back into the
+        pool (padding rows land on the scratch block). Donated + pinned to
+        the pool's sharding like every other step program."""
+        out = {"k": cache["k"].at[:, idx].set(kp),
+               "v": cache["v"].at[:, idx].set(vp)}
+        if sp is not None:
+            out["kv_scale"] = cache["kv_scale"].at[:, idx].set(sp)
+        return out
+
+    def _flush_promotes(self) -> None:
+        """The promote-completion fence: upload every queued promotion's
+        payload into its pool block BEFORE the next device step can read
+        it. Called at every dispatch site; the NVMe ticket reads started at
+        attach time overlap all host-side batch building in between. A
+        payload whose tier read failed is zero-filled (loudly) — the
+        sequence computes on zeros rather than on whatever the evicted
+        block left behind."""
+        recs, self._promote_q = self._promote_q, []
+        if not recs:
+            return
+        stale = [r for r in recs if r.epoch != self.prefix_cache.epoch]
+        if stale:
+            # a clear() between attach and this fence released these
+            # records' blocks — by now they may belong to another
+            # sequence, so the payloads must NOT be scattered. Their store
+            # entries are ours to drop too: the nodes were promoted
+            # (handle already cleared), so the tree's clear() could not
+            # reach these keys.
+            for rec in stale:
+                rec.fetch.release()
+                self._tier_store.discard(rec.key)
+            recs = [r for r in recs if r.epoch == self.prefix_cache.epoch]
+            if not recs:
+                return
+        n = len(recs)
+        npad = max(4, 1 << (n - 1).bit_length())
+        kt = self.cache["k"]
+        kp = np.zeros((kt.shape[0], npad) + kt.shape[2:], kt.dtype)
+        vp = np.zeros_like(kp)
+        sp = None
+        if "kv_scale" in self.cache:
+            st = self.cache["kv_scale"]
+            sp = np.zeros((st.shape[0], npad) + st.shape[2:], st.dtype)
+        idx = np.full((npad,), self.num_blocks, np.int32)  # pad -> scratch
+        failed = []
+        for i, rec in enumerate(recs):
+            idx[i] = rec.block
+            try:
+                parts = rec.fetch.wait()
+            except Exception as e:
+                # not just IO errors: a lazy NVMe fetch submits its read
+                # INSIDE wait() (pool.get / swap_in_start can raise under
+                # the very host-memory pressure that put us in this tier).
+                # Zero-fill and keep going — letting the exception out here
+                # would strand every later record unreleased and unuploaded
+                import logging
+
+                log_dist(f"kv tier: promote read failed for block "
+                         f"{rec.block} ({e}); zero-filling",
+                         level=logging.WARNING)
+                self._tier_store.count_miss(rec.tier)
+                failed.append(rec)
+                continue
+            kp[:, i] = parts["k"]
+            vp[:, i] = parts["v"]
+            if sp is not None:
+                sp[:, i] = parts["kv_scale"]
+        try:
+            with jax.sharding.set_mesh(self.mesh):
+                if sp is None:
+                    self.cache = self._promote_step(
+                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                        jnp.asarray(vp))
+                else:
+                    self.cache = self._promote_step(
+                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                        jnp.asarray(vp), jnp.asarray(sp))
+        except BaseException:
+            # upload never happened: re-demote onto the still-intact tier
+            # entries so the blocks (garbage) leave the tree and the
+            # fetch loans return to the pool, then surface the failure
+            self.prefix_cache.cancel_promotes(recs)
+            raise
+        now = time.perf_counter()
+        for rec in recs:
+            rec.fetch.release()
+            self._tier_store.discard(rec.key)
+            if self._promote_ms is not None and rec not in failed:
+                # failed reads are counted as tier misses, not promotes —
+                # observing them would pollute the latency an operator
+                # uses to size promote_depth / host_mb
+                self._promote_ms[rec.tier].observe(
+                    (now - rec.fetch.t_start) * 1e3)
+        self.prefix_cache.mark_uploaded(recs)
+        for rec in failed:
+            # the zero-filled block serves ONLY the in-flight acquirer:
+            # published, every future match would read zeros as KV and
+            # the next demotion would persist them into the tier
+            self.prefix_cache.drop_failed_promote(rec.node)
+
+    def tier_report(self) -> Optional[Dict]:
+        """Tier-store snapshot + pending promote depth (None = tiers off)."""
+        if self._tier_store is None:
+            return None
+        return {**self._tier_store.report(),
+                "pending_promotes": len(self._promote_q)}
+
+    def close(self) -> None:
+        """Idempotent teardown of host-side resources the engine stands up
+        beside the device pool (today: the KV tier store's pinned buffers
+        and AIO swapper). Safe to call on engines without tiers."""
+        if self._promote_q:
+            # never uploaded: drop the loans AND the nodes — the blocks
+            # hold garbage, and the prefix cache stays usable after a
+            # tier-only close(), so leaving them published would serve
+            # zeroed/garbage KV to the next matching request
+            for rec in self._promote_q:
+                rec.fetch.release()
+                if self.prefix_cache is not None:
+                    self.prefix_cache.drop_failed_promote(rec.node)
+            self._promote_q = []
+        if self._tier_store is not None:
+            self._tier_store.close()
+            self._tier_store = None
+            if self.prefix_cache is not None:
+                self.prefix_cache.tier_store = None
+                self.prefix_cache.extract_fn = None
 
     # incremental block-table cache: rows refresh only when a sequence's
     # block count changed or its slot was reused (SequenceManager bumps
@@ -463,7 +667,9 @@ class InferenceEngineV2:
         tok0 = np.zeros((bpad,), np.int32)
         tok0[:B] = np.asarray(batch_tokens, np.int32).reshape(B)
         valid = np.arange(bpad) < B
-        with jax.sharding.set_mesh(self.mesh):
+        if self._promote_q:
+            self._flush_promotes()      # fence: no read of a promoted
+        with jax.sharding.set_mesh(self.mesh):  # block before its upload
             out, self.cache = self._decode_loop(
                 self.params, self.cache, jnp.asarray(self._block_tables()),
                 jnp.asarray(slots), jnp.asarray(pos0), jnp.asarray(tok0),
@@ -603,6 +809,8 @@ class InferenceEngineV2:
             goff[i] = g
             gidx[g:g + len(c)] = starts[i] + np.arange(len(c))
             g += len(c)
+        if self._promote_q:
+            self._flush_promotes()      # promote-completion fence
         with jax.sharding.set_mesh(self.mesh):
             logits, self.cache = self._step_packed(
                 self.params, jnp.asarray(tok_ids), self.cache,
@@ -757,6 +965,8 @@ class InferenceEngineV2:
             ids[i, :len(c)] = c
             lengths[i] = len(c)
             slots[i] = d.slot
+        if self._promote_q:
+            self._flush_promotes()      # promote-completion fence
         t_host = time.perf_counter()
         with jax.sharding.set_mesh(self.mesh):
             logits, self.cache = self._prefill_step(
@@ -862,6 +1072,8 @@ class InferenceEngineV2:
             gather_idx = np.zeros((Bs,), np.int32)
             for i, c in enumerate(chunks):       # chunk end → next-token
                 gather_idx[i] = starts[i] + len(c) - 1
+            if self._promote_q:
+                self._flush_promotes()  # promote-completion fence
             t_host = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):
                 logits, self.cache = self._step_packed(

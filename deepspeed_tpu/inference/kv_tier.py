@@ -1,0 +1,401 @@
+"""Tiered storage for demoted prefix-cache KV blocks (host DRAM + NVMe).
+
+The radix :class:`~deepspeed_tpu.inference.ragged.PrefixCache` is bounded
+by the HBM block pool; under distinct-prefix churn (millions of tenants)
+leaf-first LRU eviction throws warm KV away. This module is the memory
+hierarchy behind it — ZeRO-Infinity's HBM↔host↔NVMe discipline
+(``deepspeed/runtime/swap_tensor`` lineage) turned onto the serving pool:
+
+* **host tier** — a demoted block's KV pages live in an aligned pinned
+  buffer from a :class:`~deepspeed_tpu.offload.swap.PinnedBufferPool`
+  (the PR 10 pool gains its second concurrent client); promotion is a
+  ``device_put`` straight off the pinned view — "nearly free" next to a
+  cold prefill of the same tokens.
+* **NVMe tier** — past the ``host_mb`` budget the oldest host entries
+  spill to ``<nvme_path>/kv`` through the per-op AIO ticket path
+  (:class:`~deepspeed_tpu.offload.swap.AsyncTensorSwapper`,
+  ``namespace="kv"``); promotion submits a chunked ticket read that
+  overlaps the current step's host-side batch building and fences at the
+  engine's next device dispatch.
+
+The store is deliberately dumb about *what* a block is: entries are named
+byte payloads with per-part (shape, dtype) metadata, keyed by an opaque
+int the PrefixCache chooses. One engine/batcher thread drives every store
+call (matching the serving loop's threading model); only the pinned pool
+and the AIO swapper underneath are multi-client safe.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.offload.swap import AsyncTensorSwapper, PinnedBufferPool
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["KVTierStore", "KVFetch", "TIER_HOST", "TIER_NVME"]
+
+TIER_HOST = "host"
+TIER_NVME = "nvme"
+
+
+class _Entry:
+    """One demoted block: a concatenated byte payload plus part metadata."""
+
+    __slots__ = ("key", "name", "nbytes", "parts", "buf", "wticket",
+                 "loans", "dropped")
+
+    def __init__(self, key: int, nbytes: int,
+                 parts: List[Tuple[str, tuple, np.dtype, int, int]]):
+        self.key = key
+        self.name = f"blk{key}"
+        self.nbytes = nbytes        # payload bytes (unpadded)
+        self.parts = parts          # (name, shape, dtype, offset, nbytes)
+        self.buf = None             # PinnedBuffer while in the host tier
+        self.wticket = None         # in-flight NVMe write ticket
+        self.loans = 0              # outstanding KVFetch views; pins the
+        self.dropped = False        # entry against spill/discard
+
+
+class KVFetch:
+    """One block's payload coming back from a tier.
+
+    ``wait()`` returns ``{part_name: ndarray view}``; the views stay valid
+    until :meth:`release` (host: over the entry's pinned buffer; NVMe: over
+    the read ticket's loaned pool buffer). ``submitted`` is False for a
+    promote past ``promote_depth`` — the read is submitted lazily inside
+    ``wait()`` at the engine's fence instead of up front."""
+
+    __slots__ = ("store", "entry", "tier", "t_start", "_ticket", "_lazy",
+                 "_parts", "_released")
+
+    def __init__(self, store: "KVTierStore", entry: _Entry, tier: str,
+                 ticket=None, lazy: bool = False):
+        self.store = store
+        self.entry = entry
+        self.tier = tier
+        self.t_start = time.perf_counter()
+        self._ticket = ticket
+        self._lazy = lazy
+        self._parts: Optional[Dict[str, np.ndarray]] = None
+        self._released = False
+
+    @property
+    def submitted(self) -> bool:
+        return not self._lazy
+
+    def _slice_parts(self, blob: np.ndarray) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name, shape, dtype, off, nb in self.entry.parts:
+            out[name] = blob[off:off + nb].view(dtype).reshape(shape)
+        return out
+
+    def wait(self) -> Dict[str, np.ndarray]:
+        """Block until the payload is host-resident; returns part views."""
+        if self._parts is not None:
+            return self._parts
+        if self.tier == TIER_HOST:
+            blob = self.entry.buf.data[:self.entry.nbytes]
+        else:
+            if self._lazy:
+                self._ticket = self.store._submit_read(self.entry)
+                self._lazy = False
+            blob = self._ticket.wait()[:self.entry.nbytes]
+        self._parts = self._slice_parts(blob)
+        return self._parts
+
+    def release(self) -> None:
+        """Drop the views. Host entries keep their pinned buffer (the entry
+        still owns it — :meth:`KVTierStore.discard` returns it); NVMe read
+        tickets hand their loaned pool buffer back. Idempotent."""
+        if self._released:
+            return
+        self._released = True
+        self._parts = None
+        if self.tier == TIER_NVME and self._ticket is not None:
+            self.store._reads_inflight -= 1
+            try:
+                self._ticket.release()
+            except Exception:
+                # release() implies wait(), which raises for a failed
+                # chunk on paths that never waited (cancel / teardown
+                # loops over many fetches). The ticket already returned
+                # its buffer before raising; letting the error escape
+                # here would strand every later fetch in those loops.
+                pass
+        elif self.tier == TIER_NVME and self._lazy:
+            self._lazy = False      # cancelled before submit: nothing loaned
+        self.entry.loans -= 1
+        if self.entry.loans == 0 and self.entry.dropped:
+            # a discard arrived while this fetch pinned the entry — finish
+            # it now that the last view is gone
+            self.store.discard(self.entry.key)
+
+
+class KVTierStore:
+    """Demoted-KV block store: pinned host tier with LRU spill to NVMe.
+
+    ``put`` copies a block's KV pages into a pooled pinned buffer and, when
+    the host tier exceeds ``host_bytes``, spills the oldest entries to the
+    NVMe swapper (or, with no NVMe tier, drops them through ``on_drop`` so
+    the radix tree detaches the dead node). ``fetch_start`` begins a
+    promote — immediate for host entries, an async AIO ticket read for
+    NVMe — and ``discard`` ends an entry's life in the store (the block is
+    HBM-resident again, or dead).
+
+    ``instruments`` is an optional per-tier dict of registry instruments:
+    ``{tier: {"hits": Counter, "misses": Counter, "demotions": Counter,
+    "bytes": Gauge}}`` — the engine owns the ``promote_ms`` histograms
+    because promote completion is only known at its upload fence.
+    """
+
+    def __init__(self, host_mb: float = 64.0, nvme_path: str = "",
+                 promote_depth: int = 4,
+                 pool: Optional[PinnedBufferPool] = None,
+                 swapper: Optional[AsyncTensorSwapper] = None,
+                 on_drop: Optional[Callable[[int], None]] = None,
+                 instruments: Optional[Dict[str, Dict]] = None):
+        self.host_bytes = int(host_mb * (1 << 20))
+        self.promote_depth = int(promote_depth)
+        self.pool = pool if pool is not None else PinnedBufferPool()
+        self._own_swapper = swapper is None and bool(nvme_path)
+        if swapper is not None:
+            self.swapper = swapper
+        elif nvme_path:
+            # the KV namespace scopes this client's files away from any
+            # optimizer swapper sharing the device; the pinned pool is
+            # shared with the host tier (one pool, two clients)
+            self.swapper = AsyncTensorSwapper(nvme_path, namespace="kv",
+                                              pool=self.pool)
+        else:
+            self.swapper = None
+        self.on_drop = on_drop
+        self._inst = instruments or {}
+        self._host: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._nvme: Dict[int, _Entry] = {}
+        self._host_used = 0
+        self._nvme_used = 0
+        self._reads_inflight = 0
+        self.counters: Dict[str, int] = {
+            "host_demotions": 0, "nvme_demotions": 0,
+            "host_hits": 0, "nvme_hits": 0,
+            "host_misses": 0, "nvme_misses": 0, "dropped": 0,
+        }
+
+    # ------------------------------------------------------------------
+    def _count(self, tier: str, what: str, n: int = 1) -> None:
+        self.counters[f"{tier}_{what}"] += n
+        inst = self._inst.get(tier, {})
+        if what in inst:
+            inst[what].inc(float(n))
+
+    def _set_bytes(self) -> None:
+        for tier, used in ((TIER_HOST, self._host_used),
+                           (TIER_NVME, self._nvme_used)):
+            g = self._inst.get(tier, {}).get("bytes")
+            if g is not None:
+                g.set(float(used))
+
+    def count_miss(self, tier: str, n: int = 1) -> None:
+        """Record a tier miss discovered by the caller (a promote read
+        that failed after fetch_start)."""
+        self._count(tier, "misses", n)
+
+    # ------------------------------------------------------------------
+    def has(self, key: int) -> bool:
+        return key in self._host or key in self._nvme
+
+    def tier_of(self, key: int) -> Optional[str]:
+        if key in self._host:
+            return TIER_HOST
+        if key in self._nvme:
+            return TIER_NVME
+        return None
+
+    def put(self, key: int, parts: Dict[str, np.ndarray]) -> bool:
+        """Demote one block's KV pages into the host tier. Returns False
+        (caller falls back to plain eviction) only if the pinned copy
+        itself fails; budget pressure spills other entries instead."""
+        metas: List[Tuple[str, tuple, np.dtype, int, int]] = []
+        off = 0
+        for name in sorted(parts):
+            a = parts[name]
+            metas.append((name, tuple(a.shape), a.dtype, off, a.nbytes))
+            off += a.nbytes
+        buf = self.pool.get(off)
+        try:
+            for name, shape, dtype, o, nb in metas:
+                buf.data[o:o + nb] = (np.ascontiguousarray(parts[name])
+                                      .view(np.uint8).reshape(-1))
+        except BaseException:
+            # the pinned copy is the only fallible work between pool.get
+            # and the entry taking ownership — return the buffer or it
+            # leaks out of the pool for the rest of the run
+            self.pool.put(buf)
+            raise
+        entry = _Entry(key, off, metas)
+        entry.buf = buf
+        self._host[key] = entry
+        self._host_used += off
+        self._count(TIER_HOST, "demotions")
+        self._spill(protect=key)
+        self._set_bytes()
+        return True
+
+    def _spill(self, protect: Optional[int] = None) -> None:
+        """Move oldest host entries to NVMe while over the host budget (or
+        drop them, via ``on_drop``, when there is no NVMe tier). Entries a
+        live :class:`KVFetch` has pinned (``loans > 0``) are skipped — the
+        promote path holds views over their buffers. ``protect`` shields
+        the entry ``put()`` is inserting RIGHT NOW: dropping it would fire
+        ``on_drop`` before the radix cache has recorded the handle, so the
+        node would keep a dead handle nothing can ever clean up."""
+        while self._host_used > self.host_bytes and len(self._host) > 1:
+            key = e = None
+            for k, cand in self._host.items():
+                if cand.loans == 0 and k != protect:
+                    key, e = k, cand
+                    break
+            if e is None:
+                break               # everything old is pinned by promotes
+            del self._host[key]
+            self._host_used -= e.nbytes
+            if self.swapper is None:
+                self._drop_entry(e, TIER_HOST)
+                continue
+            try:
+                blob = e.buf.data[:e.nbytes]
+                # swap_out copies into its OWN pooled buffer at submit
+                # time, so the host entry's buffer can recycle immediately
+                e.wticket = self.swapper.swap_out(e.name, blob)
+            except Exception as ex:
+                logger.warning(f"kv tier: NVMe demotion of {e.name} failed "
+                               f"({ex}); dropping the entry")
+                self._drop_entry(e, TIER_HOST)
+                continue
+            self.pool.put(e.buf)
+            e.buf = None
+            self._nvme[key] = e
+            self._nvme_used += e.nbytes
+            self._count(TIER_NVME, "demotions")
+
+    def _drop_entry(self, e: _Entry, tier: str) -> None:
+        self.counters["dropped"] += 1
+        self._count(tier, "misses")
+        if e.buf is not None:
+            self.pool.put(e.buf)
+            e.buf = None
+        if self.on_drop is not None:
+            self.on_drop(e.key)
+
+    # ------------------------------------------------------------------
+    def _submit_read(self, e: _Entry):
+        if e.wticket is not None:
+            # the demotion write may still be in flight: reading the file
+            # before it lands would return a torn payload
+            e.wticket.wait()
+            e.wticket = None
+        self._reads_inflight += 1
+        try:
+            return self.swapper.swap_in_start(e.name)
+        except BaseException:
+            self._reads_inflight -= 1
+            raise
+
+    def fetch_start(self, key: int) -> Optional[KVFetch]:
+        """Begin promoting ``key``'s payload back toward HBM. Host entries
+        resolve immediately; NVMe entries submit an async ticket read now
+        (or lazily at ``wait()`` once ``promote_depth`` reads are already
+        in flight). None = the entry is gone (tier miss — recompute)."""
+        e = self._host.get(key)
+        if e is not None:
+            self._host.move_to_end(key)          # promote = hottest
+            self._count(TIER_HOST, "hits")
+            e.loans += 1
+            return KVFetch(self, e, TIER_HOST)
+        e = self._nvme.get(key)
+        if e is None:
+            return None
+        self._count(TIER_NVME, "hits")
+        if self._reads_inflight >= self.promote_depth:
+            e.loans += 1
+            return KVFetch(self, e, TIER_NVME, lazy=True)
+        try:
+            ticket = self._submit_read(e)
+        except Exception as ex:
+            logger.warning(f"kv tier: NVMe promote read of {e.name} failed "
+                           f"to submit ({ex})")
+            self.discard(key)
+            self._count(TIER_NVME, "misses")
+            return None
+        e.loans += 1
+        return KVFetch(self, e, TIER_NVME, ticket=ticket)
+
+    def discard(self, key: int) -> None:
+        """Remove ``key`` from the store (promoted back to HBM, or dead).
+        Idempotent; host buffers return to the pool, NVMe files are
+        removed best-effort. An entry a live fetch still pins is marked and
+        discarded when its last view releases."""
+        e = self._host.get(key) or self._nvme.get(key)
+        if e is None:
+            return
+        if e.loans > 0:
+            e.dropped = True
+            return
+        if self._host.pop(key, None) is not None:
+            self._host_used -= e.nbytes
+            if e.buf is not None:
+                self.pool.put(e.buf)
+                e.buf = None
+            self._set_bytes()
+            return
+        self._nvme.pop(key, None)
+        self._nvme_used -= e.nbytes
+        if e.wticket is not None:
+            try:
+                e.wticket.wait()
+            except Exception:
+                pass
+            e.wticket = None
+        self.swapper.discard(e.name)
+        self._set_bytes()
+
+    # ------------------------------------------------------------------
+    def clear(self) -> int:
+        """Drop every entry (pool buffers returned, files removed).
+        Returns entries cleared. On-drop is NOT fired — clear() is the
+        tree telling the store to forget, not the store losing data."""
+        n = len(self._host) + len(self._nvme)
+        for key in list(self._host):
+            self.discard(key)
+        for key in list(self._nvme):
+            self.discard(key)
+        return n
+
+    def entries(self) -> int:
+        return len(self._host) + len(self._nvme)
+
+    def report(self) -> Dict:
+        return {
+            "host_entries": len(self._host),
+            "host_bytes": self._host_used,
+            "host_budget_bytes": self.host_bytes,
+            "nvme_entries": len(self._nvme),
+            "nvme_bytes": self._nvme_used,
+            "nvme": self.swapper is not None,
+            "reads_inflight": self._reads_inflight,
+            "pool": self.pool.report(),
+            **self.counters,
+        }
+
+    def close(self) -> None:
+        """Idempotent teardown: drop every entry and close the private
+        swapper (a shared swapper passed in by the caller is left open)."""
+        self.clear()
+        if self.swapper is not None:
+            if self._own_swapper:
+                self.swapper.close()
+            self.swapper = None

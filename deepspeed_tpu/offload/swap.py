@@ -81,8 +81,9 @@ class PinnedBufferPool:
         self.max_cached = max_cached
         self._free: List[PinnedBuffer] = []  #: guarded_by: _lock
         self._lock = threading.Lock()
-        # stats mutate on get/put from both the Adam worker and the main
-        # upload thread — count under the lock or they drift
+        # stats mutate on get/put from concurrent clients (the Adam worker,
+        # the main upload thread, and the serving KV-tier promote path all
+        # share one pool) — count under the lock or they drift
         self.allocations = 0     #: guarded_by: _lock
         self.reuses = 0          #: guarded_by: _lock
         self.outstanding = 0     #: guarded_by: _lock
@@ -108,6 +109,13 @@ class PinnedBufferPool:
 
     def put(self, buf: PinnedBuffer) -> None:
         with self._lock:
+            # double-put guard: with two concurrent clients, recycling the
+            # same buffer twice would let get() hand one physical buffer to
+            # two owners — live IO silently aliased. Identity check, not
+            # equality (buffers never compare equal by content here).
+            if any(b is buf for b in self._free):
+                raise RuntimeError(
+                    "PinnedBuffer returned to the pool twice (double put)")
             self.outstanding -= 1
             if len(self._free) < self.max_cached:
                 self._free.append(buf)
@@ -241,7 +249,13 @@ class AsyncTensorSwapper:
     def __init__(self, swap_dir: str, num_threads: int = 0,
                  o_direct: bool = False, chunk_mb: int = 0,
                  autotune: bool = False, autotune_cache: str = "",
-                 pool: Optional[PinnedBufferPool] = None):
+                 pool: Optional[PinnedBufferPool] = None,
+                 namespace: str = ""):
+        # a namespace scopes this swapper's files to a subdirectory so two
+        # clients of one swap device cannot collide on names (the serving
+        # KV tier uses namespace="kv" beside the optimizer's leaf files)
+        if namespace:
+            swap_dir = os.path.join(swap_dir, namespace)
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.o_direct = o_direct
@@ -425,6 +439,18 @@ class AsyncTensorSwapper:
         except BaseException:
             self._release_failed_submit(ids, buf)
             raise
+
+    def discard(self, name: str) -> None:
+        """Forget a swapped array: drop its metadata and best-effort remove
+        the backing file. Long-lived clients that churn names (the serving
+        KV tier demoting millions of distinct prefixes) would otherwise
+        grow the swap dir and ``_meta`` without bound. The caller must not
+        discard a name with ops still in flight."""
+        self._meta.pop(name, None)
+        try:
+            os.remove(self._path(name))
+        except OSError:
+            pass
 
     def swap_in(self, name: str) -> np.ndarray:
         """Blocking read returning an owned array (buffer goes back to the

@@ -133,6 +133,7 @@ class ContinuousBatcher:
             "engine_steps": 0, "idle_steps": 0, "step_failures": 0,
             "decode_tokens": 0, "prefill_tokens": 0, "degraded_entries": 0,
             "prefix_hit_requests": 0, "prefix_hit_tokens": 0,
+            "tier_hit_requests": 0, "tier_promoted_blocks": 0,
             "spec_rounds": 0, "spec_draft_tokens": 0,
             "spec_accepted_tokens": 0,
         }
@@ -198,16 +199,24 @@ class ContinuousBatcher:
 
     def _blocks_needed(self, req) -> int:
         """Worst-case NEW blocks a queued request needs: its full demand
-        minus whatever prompt prefix is already resident in the cache — a
+        minus whatever prompt prefix is already RESIDENT in the cache — a
         90%-cached request is nearly free and should admit as such. (The
         peeked blocks can be evicted before the request reaches the engine;
         admission is worst-case-projection math already, and the engine
-        re-matches at attach time.)"""
+        re-matches at attach time.)
+
+        Demoted-but-promotable blocks are warm capacity, not free
+        capacity: a promote allocates a pool block per matched entry, so
+        they stay in the block demand — but the request pays only the
+        promote-latency tax for them (an async host/NVMe fetch overlapped
+        under the step), never the cold prefill compute. That is exactly
+        how they are costed: blocks yes, prefill no."""
         demand = req.total_token_demand
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None and req.prompt_len > 1:
-            demand -= pc.peek(req.prompt,
-                              max_tokens=req.prompt_len - 1)[1]
+            info = pc.peek_tiers(req.prompt,
+                                 max_tokens=req.prompt_len - 1)
+            demand -= info["resident_tokens"]
         return self._blocks_for(demand)
 
     def _spec_enabled(self) -> bool:
@@ -295,6 +304,8 @@ class ContinuousBatcher:
                 break          # FIFO head-of-line: don't starve big requests
             mgr.admit(req)
             if getattr(self.engine, "prefix_cache", None) is not None:
+                pc = self.engine.prefix_cache
+                promoted0 = pc.counters["promoted_blocks"]
                 hit = self.engine.prefix_attach(req.uid, req.prompt)
                 if hit:
                     # the cached prefix is already in KV: prefill starts at
@@ -302,6 +313,13 @@ class ContinuousBatcher:
                     req.prefilled = hit
                     self.counters["prefix_hit_requests"] += 1
                     self.counters["prefix_hit_tokens"] += hit
+                    promoted = pc.counters["promoted_blocks"] - promoted0
+                    if promoted > 0:
+                        # warm-but-demoted share: served from host/NVMe via
+                        # async promote instead of recompute — the "nearly
+                        # free" hit the tier projection priced in
+                        self.counters["tier_hit_requests"] += 1
+                        self.counters["tier_promoted_blocks"] += promoted
             # O(1) exact projection update for hit and miss alike: the
             # admitted request's remaining need plus the blocks its attach
             # just pinned out of the reclaimable set sum to its full
@@ -703,7 +721,10 @@ class ContinuousBatcher:
                    "free_blocks": self.num_blocks - self.used_blocks,
                    "cache_blocks": self.cache_blocks,
                    "reclaimable_blocks": self.reclaimable_blocks,
-                   "occupancy": round(self.kv_occupancy, 4)},
+                   "occupancy": round(self.kv_occupancy, 4),
+                   "tiers": (self.engine.tier_report()
+                             if hasattr(self.engine, "tier_report")
+                             else None)},
             "prefix_cache": pc.report() if pc is not None else None,
             "speculative": spec,
             "latency_ms": {"p50": round(self._latency_pct(50), 3),

@@ -300,6 +300,37 @@ class TestResourceLifecycle:
         """, rules=["resource-lifecycle"])
         assert fs == []
 
+    def test_demote_acquire_submit_pair_flagged(self, tmp_path):
+        # ISSUE 12 regression: the KV-tier demote path's shape — a pinned
+        # buffer acquired, then a fallible copy + AIO ticket submit before
+        # anything owns the buffer. An exception in either leaks it.
+        fs = lint(tmp_path, """
+            class TierStore:
+                def demote(self, key, parts):
+                    buf = self.pool.get(parts.nbytes)
+                    buf.data[:parts.nbytes] = parts.tobytes()
+                    ticket = self.swapper.swap_out(key, buf.data)
+                    self.entries[key] = (buf, ticket)
+        """, rules=["resource-lifecycle"])
+        assert rules_of(fs) == ["resource-lifecycle"]
+
+    def test_demote_guarded_pair_is_clean(self, tmp_path):
+        # the shipped idiom: copy + submit under try, buffer returned on
+        # the exception path before the original failure propagates
+        fs = lint(tmp_path, """
+            class TierStore:
+                def demote(self, key, parts):
+                    buf = self.pool.get(parts.nbytes)
+                    try:
+                        buf.data[:parts.nbytes] = parts.tobytes()
+                        ticket = self.swapper.swap_out(key, buf.data)
+                    except BaseException:
+                        self.pool.put(buf)
+                        raise
+                    self.entries[key] = (buf, ticket)
+        """, rules=["resource-lifecycle"])
+        assert fs == []
+
     def test_plain_dict_and_queue_get_are_clean(self, tmp_path):
         fs = lint(tmp_path, """
             class Router:

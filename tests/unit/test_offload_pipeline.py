@@ -503,3 +503,136 @@ class TestSubmitFailureReclaim:
         assert sw.pending == 0                 # ...only after the reap
         del sw._submit_chunks
         sw.close()
+
+
+class TestPinnedPoolConcurrency:
+    """ISSUE 12 satellite: the pool gains a second concurrent client (the
+    serving KV-tier promote path beside the Adam pipeline) — its free-list
+    discipline must hold under multi-threaded get/release/abort churn, and
+    returning one buffer twice must raise instead of silently aliasing."""
+
+    def test_double_put_raises(self):
+        from deepspeed_tpu.offload import PinnedBufferPool
+
+        pool = PinnedBufferPool()
+        buf = pool.get(4096)
+        pool.put(buf)
+        with pytest.raises(RuntimeError, match="twice"):
+            pool.put(buf)
+
+    def test_multithreaded_get_release_stress(self):
+        import random
+        import threading
+
+        from deepspeed_tpu.offload import PinnedBufferPool
+
+        pool = PinnedBufferPool(max_cached=16)
+        stop = threading.Event()
+        errors = []
+        gets = [0] * 6
+
+        def client(idx):
+            rng = random.Random(idx)
+            held = []
+            try:
+                while not stop.is_set():
+                    if held and rng.random() < 0.5:
+                        pool.put(held.pop(rng.randrange(len(held))))
+                    else:
+                        nbytes = rng.choice((4096, 65536, 1 << 20))
+                        buf = pool.get(nbytes)
+                        # exclusive ownership: stamp and verify — a buffer
+                        # handed to two clients would tear this pattern
+                        buf.data[:8] = idx
+                        held.append(buf)
+                        gets[idx] += 1
+                        if buf.data[0] != idx or buf.data[7] != idx:
+                            raise RuntimeError("buffer aliased")
+                        if len(held) > 4:
+                            pool.put(held.pop(0))
+                for b in held:
+                    pool.put(b)
+            except BaseException as e:      # surfaced to the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        rep = pool.report()
+        assert rep["outstanding"] == 0
+        assert rep["allocations"] + rep["reuses"] == sum(gets)
+        # the free list holds no aliased entries
+        ids = [id(b) for b in pool._free]
+        assert len(ids) == len(set(ids))
+
+    def test_stress_with_concurrent_swapper_clients(self, tmp_path):
+        """Two swappers (the Adam pipeline shape and the KV-tier shape)
+        sharing ONE pool from different threads: every roundtrip stays
+        bit-exact and the pool fully restores."""
+        if not AsyncIOBuilder().is_compatible():
+            pytest.skip("g++ toolchain unavailable")
+        import threading
+
+        from deepspeed_tpu.offload import AsyncTensorSwapper, PinnedBufferPool
+
+        pool = PinnedBufferPool()
+        sw_a = AsyncTensorSwapper(str(tmp_path), num_threads=2, pool=pool)
+        sw_b = AsyncTensorSwapper(str(tmp_path), num_threads=2, pool=pool,
+                                  namespace="kv")
+        errors = []
+
+        def run(sw, tag, scale):
+            try:
+                for i in range(12):
+                    arr = (np.arange(50_000, dtype=np.float32) + i) * scale
+                    sw.swap_out(f"{tag}{i % 3}", arr).wait()
+                    t = sw.swap_in_start(f"{tag}{i % 3}")
+                    got = t.wait()
+                    if not np.array_equal(got, arr):
+                        raise RuntimeError(f"torn roundtrip {tag}{i}")
+                    t.release()
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(sw_a, "a", 1.0)),
+                   threading.Thread(target=run, args=(sw_b, "b", -2.0))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert pool.outstanding == 0
+        sw_a.close()
+        sw_b.close()
+
+
+@requires_native
+class TestSwapperNamespace:
+    """ISSUE 12 satellite: the KV tier is a second client of one swap
+    device — its files must live under the namespace subdir, and discard()
+    must bound disk for name-churning clients."""
+
+    def test_namespace_scopes_files(self, tmp_path):
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), namespace="kv",
+                                num_threads=1)
+        arr = np.arange(1024, dtype=np.float32)
+        sw.swap_out("blk0", arr).wait()
+        assert os.path.exists(os.path.join(str(tmp_path), "kv",
+                                           "blk0.swp"))
+        np.testing.assert_array_equal(sw.swap_in("blk0"), arr)
+        sw.discard("blk0")
+        assert not os.path.exists(os.path.join(str(tmp_path), "kv",
+                                               "blk0.swp"))
+        with pytest.raises(KeyError):
+            sw.swap_in_start("blk0")           # metadata gone too
+        sw.close()

@@ -535,7 +535,586 @@ def test_serving_prefix_spec_exact_and_metered(feat_eng, plain_eng):
 
 
 # ---------------------------------------------------------------------------
-# drill wrapper (slow; the CLI is the invariant authority)
+# tiered KV spill: KVTierStore semantics (host budget, NVMe spill, loans)
+# ---------------------------------------------------------------------------
+
+def _payload(v, shape=(2, 4)):
+    return {"k": np.full(shape, v, np.float32),
+            "v": np.full(shape, -v, np.float32)}
+
+
+class TestKVTierStore:
+    def test_host_roundtrip_and_discard(self):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=1.0)
+        assert store.put(0, _payload(3))
+        f = store.fetch_start(0)
+        assert f.tier == "host"
+        parts = f.wait()
+        assert np.array_equal(parts["k"], _payload(3)["k"])
+        f.release()
+        store.discard(0)
+        assert store.entries() == 0
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_spill_to_nvme_and_promote(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        # budget holds ~1 entry (64 B payloads): older entries must spill
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path))
+        for i in range(3):
+            store.put(i, _payload(i))
+        rep = store.report()
+        assert rep["nvme_entries"] >= 1 and rep["nvme_demotions"] >= 1
+        f = store.fetch_start(0)              # oldest: must be on NVMe
+        assert f.tier == "nvme"
+        assert np.array_equal(f.wait()["k"], _payload(0)["k"])
+        f.release()
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_host_budget_without_nvme_drops_via_callback(self):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        dropped = []
+        store = KVTierStore(host_mb=100 / 2**20, on_drop=dropped.append)
+        for i in range(4):
+            store.put(i, _payload(i))
+        assert dropped and all(store.tier_of(k) is None for k in dropped)
+        assert store.counters["dropped"] == len(dropped)
+        # the survivors still fetch
+        live = [k for k in range(4) if store.has(k)]
+        assert live
+        f = store.fetch_start(live[-1])
+        f.wait()
+        f.release()
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_loaned_entry_never_spilled(self):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        dropped = []
+        store = KVTierStore(host_mb=100 / 2**20, on_drop=dropped.append)
+        store.put(0, _payload(0))
+        f = store.fetch_start(0)              # pins entry 0
+        parts = f.wait()
+        before = parts["k"].copy()
+        for i in range(1, 5):                 # budget pressure on top
+            store.put(i, _payload(i))
+        # the loaned entry survived and its bytes were never recycled
+        assert store.has(0) and 0 not in dropped
+        assert np.array_equal(parts["k"], before)
+        # a discard mid-loan defers until the fetch releases
+        store.discard(0)
+        assert store.has(0)
+        f.release()
+        assert not store.has(0)
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_put_never_drops_its_own_entry_mid_spill(self):
+        # host budget below one entry, no NVMe, every older entry pinned
+        # by a live fetch: the spill inside put() must not drop the entry
+        # being inserted — on_drop would fire before the radix cache has
+        # recorded the handle, leaving a demoted node with a dead handle
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=40 / 2**20)   # < one 64-byte entry
+        dropped = []
+        store.on_drop = dropped.append
+        store.put(1, _payload(1))
+        f = store.fetch_start(1)                  # pins entry 1
+        store.put(2, _payload(2))                 # over budget, 1 pinned
+        assert store.has(2) and not dropped       # 2 survives its own put
+        f.release()
+        store.put(3, _payload(3))                 # older entries now fair game
+        assert store.has(3) and set(dropped) == {1, 2}
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_promote_depth_defers_read_submission(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=1 / 2**20, nvme_path=str(tmp_path),
+                            promote_depth=1)
+        for i in range(3):
+            store.put(i, _payload(i))
+        assert store.report()["nvme_entries"] >= 2
+        f0 = store.fetch_start(0)
+        f1 = store.fetch_start(1)
+        assert f0.submitted and not f1.submitted   # depth 1: second defers
+        assert np.array_equal(f0.wait()["k"], _payload(0)["k"])
+        assert np.array_equal(f1.wait()["k"], _payload(1)["k"])
+        f0.release()
+        f1.release()
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+
+# ---------------------------------------------------------------------------
+# tiered PrefixCache semantics (fake extract: no device in the loop)
+# ---------------------------------------------------------------------------
+
+def _tiered_cache(num_blocks=8, block_size=4, **store_kw):
+    from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+    alloc = BlockedAllocator(num_blocks, block_size=block_size)
+    pc = PrefixCache(alloc)
+    store = KVTierStore(**{"host_mb": 1.0, **store_kw})
+    payloads = {}
+
+    def extract(blocks):
+        return [dict(payloads[b]) for b in blocks]
+
+    pc.attach_tier_store(store, extract)
+
+    def publish(toks, val):
+        blks = alloc.allocate(len(toks) // block_size)
+        for b in blks:
+            payloads[b] = _payload(val)
+        pc.insert(toks, blks)
+        alloc.free(blks)
+        return blks
+
+    publish.payloads = payloads
+    return alloc, pc, store, publish
+
+
+class TestTieredPrefixCache:
+    def test_demote_instead_of_evict_keeps_nodes(self):
+        alloc, pc, store, publish = _tiered_cache()
+        publish(np.arange(8, dtype=np.int32), 1)
+        assert pc.evict(2) == 2                  # HBM blocks freed...
+        assert alloc.free_blocks == alloc.num_blocks
+        rep = pc.report()
+        assert rep["blocks"] == 0 and rep["demoted_nodes"] == 2
+        assert rep["demoted_blocks"] == 2 and store.entries() == 2
+        # ...but the prefix still matches, as warm-not-resident
+        info = pc.peek_tiers(np.arange(8, dtype=np.int32))
+        assert info["matched_tokens"] == 8
+        assert info["resident_tokens"] == 0 and info["demoted_blocks"] == 2
+
+    def test_acquire_promotes_with_pending_upload(self):
+        alloc, pc, store, publish = _tiered_cache()
+        toks = np.arange(8, dtype=np.int32)
+        publish(toks, 7)
+        pc.evict(2)
+        blocks, n = pc.acquire(toks)
+        assert n == 8 and len(blocks) == 2
+        recs = pc.drain_promotes()
+        assert len(recs) == 2 and pc.report()["promoted_blocks"] == 2
+        for r in recs:
+            assert np.array_equal(r.fetch.wait()["k"], _payload(7)["k"])
+            r.fetch.release()
+            store.discard(r.key)
+        # promoted blocks are live (cache + acquirer refs) and pinned
+        assert all(alloc.refcount(b) == 2 for b in blocks)
+        assert pc.evictable_blocks() == 0
+        alloc.free(blocks)
+        assert pc.evictable_blocks() == 2
+        pc.clear()
+        assert alloc.free_blocks == alloc.num_blocks
+        assert not alloc.leaked_blocks() and store.entries() == 0
+
+    def test_cancel_promotes_redemotes_and_frees(self):
+        alloc, pc, store, publish = _tiered_cache()
+        toks = np.arange(8, dtype=np.int32)
+        publish(toks, 5)
+        pc.evict(2)
+        blocks, n = pc.acquire(toks)
+        recs = pc.drain_promotes()
+        # the acquirer fails before the upload fence: free its refs, then
+        # cancel — nodes re-demote onto their still-live store entries
+        alloc.free(blocks)
+        pc.cancel_promotes(recs)
+        assert alloc.free_blocks == alloc.num_blocks
+        rep = pc.report()
+        assert rep["blocks"] == 0 and rep["demoted_nodes"] == 2
+        assert store.entries() == 2
+        # and the prefix is still servable afterwards
+        blocks2, n2 = pc.acquire(toks)
+        assert n2 == 8
+        for r in pc.drain_promotes():
+            assert np.array_equal(r.fetch.wait()["k"], _payload(5)["k"])
+            r.fetch.release()
+            store.discard(r.key)
+        alloc.free(blocks2)
+        pc.clear()
+        assert not alloc.leaked_blocks() and store.entries() == 0
+
+    def test_republish_readopts_demoted_nodes(self):
+        alloc, pc, store, publish = _tiered_cache()
+        toks = np.arange(8, dtype=np.int32)
+        publish(toks, 2)
+        pc.evict(2)
+        assert store.entries() == 2
+        # a second sequence publishes identical content: nodes re-adopt its
+        # private blocks — no tier fetch, store entries released
+        publish(toks, 2)
+        rep = pc.report()
+        assert rep["readopted_blocks"] == 2 and rep["demoted_nodes"] == 0
+        assert store.entries() == 0
+        info = pc.peek_tiers(toks)
+        assert info["resident_tokens"] == 8
+
+    def test_dropped_tier_entry_detaches_subtree(self):
+        # no NVMe + tiny host budget: demotions past the budget drop the
+        # oldest entries, and the radix tree must forget those nodes
+        alloc, pc, store, publish = _tiered_cache(
+            num_blocks=16, host_mb=150 / 2**20)
+        for i in range(4):
+            publish(np.arange(i * 100, i * 100 + 8, dtype=np.int32), i)
+            pc.evict(2)
+        assert store.counters["dropped"] >= 1
+        assert pc.report()["tier_lost_blocks"] >= 1
+        # every remaining match still resolves cleanly (dead prefixes miss)
+        total = 0
+        for i in range(4):
+            toks = np.arange(i * 100, i * 100 + 8, dtype=np.int32)
+            blocks, n = pc.acquire(toks)
+            for r in pc.drain_promotes():
+                r.fetch.wait()
+                r.fetch.release()
+                store.discard(r.key)
+            total += n
+            alloc.free(blocks)
+        assert 0 < total < 4 * 8
+        pc.clear()
+        assert alloc.free_blocks == alloc.num_blocks
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_deep_chain_demotes_leaf_first_bottom_up(self):
+        # demoted children must not pin their parents: a fully-unreferenced
+        # chain demotes bottom-up until the whole path is in the store
+        alloc, pc, store, publish = _tiered_cache(num_blocks=8)
+        toks = np.arange(16, dtype=np.int32)          # 4-block chain
+        publish(toks, 9)
+        assert pc.evict(4) == 4
+        rep = pc.report()
+        assert rep["blocks"] == 0 and rep["demoted_nodes"] == 4
+        info = pc.peek_tiers(toks)
+        assert info["matched_tokens"] == 16 and info["demoted_blocks"] == 4
+
+    def test_pending_upload_blocks_resist_eviction_until_fence(self):
+        # an acquirer shed between attach and the engine's fence leaves the
+        # cache sole owner of promoted blocks whose payload was NEVER
+        # uploaded: demoting one would extract garbage, freeing one would
+        # let the deferred scatter overwrite whoever gets the block next
+        alloc, pc, store, publish = _tiered_cache()
+        toks = np.arange(8, dtype=np.int32)
+        publish(toks, 3)
+        pc.evict(2)
+        blocks, n = pc.acquire(toks)
+        recs = pc.drain_promotes()
+        alloc.free(blocks)                 # acquirer gone, rc back to 1
+        assert pc.evict(2) == 0            # fence pending: untouchable
+        assert pc.report()["blocks"] == 2
+        for r in recs:                     # the fence: upload + finalize
+            assert np.array_equal(r.fetch.wait()["k"], _payload(3)["k"])
+            r.fetch.release()
+            store.discard(r.key)
+            publish.payloads[r.block] = _payload(3)
+        pc.mark_uploaded(recs)
+        assert pc.evict(2) == 2            # ordinary cache blocks again
+        pc.clear()
+        assert alloc.free_blocks == alloc.num_blocks
+        assert not alloc.leaked_blocks() and store.entries() == 0
+
+    def test_deep_chain_eviction_has_no_recursion_limit(self):
+        # candidate gathering must be iterative: one shared system prompt
+        # can be a chain far deeper than the interpreter's recursion limit
+        alloc, pc, store, publish = _tiered_cache(num_blocks=1300,
+                                                  block_size=4,
+                                                  host_mb=4.0)
+        toks = np.arange(4800, dtype=np.int32)        # 1200-block chain
+        publish(toks, 1)
+        assert pc.evict(1200) == 1200
+        rep = pc.report()
+        assert rep["blocks"] == 0 and rep["demoted_nodes"] == 1200
+        pc.clear()
+        assert store.entries() == 0 and not alloc.leaked_blocks()
+
+    def test_demote_failure_drops_orphaned_demoted_descendants(self):
+        # when the store cannot take a victim (copy failure) the fallback
+        # is plain eviction — but the victim can carry DEMOTED children,
+        # and unlinking just the victim would orphan them: unreachable
+        # nodes whose tier entries leak until clear()
+        alloc, pc, store, publish = _tiered_cache()
+        toks = np.arange(12, dtype=np.int32)          # 3-block chain
+        publish(toks, 4)
+        assert pc.evict(1) == 1                       # leaf -> demoted
+        assert store.entries() == 1
+
+        def broken_put(key, parts):
+            raise RuntimeError("pinned copy failed")
+
+        store.put = broken_put
+        assert pc.evict(1) == 1                       # plain-evict fallback
+        rep = pc.report()
+        assert store.entries() == 0                   # child went with it
+        assert rep["demoted_nodes"] == 0 and rep["blocks"] == 1
+        blocks, n = pc.acquire(toks)
+        assert n == 4                                 # only the head serves
+        assert not pc.drain_promotes()
+        alloc.free(blocks)
+        pc.clear()
+        assert not alloc.leaked_blocks()
+
+
+# ---------------------------------------------------------------------------
+# tiered KV through the engine (fp32: promote must be bit-exact)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tier_eng(f32_lm, tmp_path_factory):
+    model, params = f32_lm
+    nvme = tmp_path_factory.mktemp("kv_tier_nvme")
+    # host budget ~2 blocks (tiny block = 2*16*64*4*2 bytes) so a few
+    # demotions reach NVMe too
+    eng = _engine(model, params, num_blocks=24,
+                  prefix_cache={"enabled": True,
+                                "tiers": {"enabled": True,
+                                          "host_mb": 2 * 16384 / 2**20,
+                                          "nvme_path": str(nvme),
+                                          "promote_depth": 2}})
+    yield eng
+    eng.close()
+
+
+def _gen(eng, uid, prompt, steps=6):
+    r = eng.put([uid], [prompt])
+    out = [int(np.argmax(r[uid]))]
+    toks = eng.decode_batch([uid], [out[0]], steps=steps)
+    out += [int(t) for t in toks[uid]]
+    eng.flush([uid])
+    return out
+
+
+def test_tiered_demote_promote_token_identical(tier_eng, plain_eng):
+    """The correctness bar: the SAME prompt served (a) cold on a plain
+    engine, (b) publishing, (c) after full demotion to host+NVMe via
+    promote — all three token streams identical, pool and store restored."""
+    eng = _reset(tier_eng)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 250, 52)
+    base = _gen(_reset(plain_eng), 0, prompt)
+    first = _gen(eng, 1, prompt)
+    assert first == base
+    pc = eng.prefix_cache
+    assert pc.report()["blocks"] == 3
+    pc.evict(10)                       # demote everything (host + NVMe)
+    rep = pc.report()
+    assert rep["demoted_nodes"] == 3 and rep["blocks"] == 0
+    tiers = rep["tiers"]
+    assert tiers["host_entries"] + tiers["nvme_entries"] == 3
+    promoted = _gen(eng, 2, prompt)
+    assert promoted == base
+    rep = pc.report()
+    assert rep["promoted_blocks"] == 3
+    assert rep["tiers"]["host_hits"] + rep["tiers"]["nvme_hits"] == 3
+    pc.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+    assert eng._tier_store.entries() == 0
+    assert eng._tier_store.pool.report()["outstanding"] == 0
+
+
+def test_tier_metrics_render_in_prometheus(tier_eng):
+    """Acceptance: inference/prefix_cache_tier_{hits,promote_ms} appear in
+    the Prometheus exposition with per-tier labels."""
+    from deepspeed_tpu.observability import get_registry
+
+    eng = _reset(tier_eng)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 250, 52)
+    _gen(eng, 10, prompt)
+    eng.prefix_cache.evict(10)
+    _gen(eng, 11, prompt)              # promote -> hits + promote_ms
+    text = get_registry().render_prometheus()
+    assert 'inference_prefix_cache_tier_hits_total{tier="host"}' in text \
+        or 'inference_prefix_cache_tier_hits_total{tier="nvme"}' in text
+    assert 'inference_prefix_cache_tier_demotions_total{tier="host"}' \
+        in text
+    assert 'inference_prefix_cache_tier_promote_ms_count{tier=' in text
+    assert 'inference_prefix_cache_tier_bytes{tier="host"}' in text
+    eng.prefix_cache.clear()
+
+
+def test_tiers_config_reaches_engine(f32_lm, tmp_path):
+    from deepspeed_tpu.config.config import DeepSpeedTpuConfig
+
+    cfg = DeepSpeedTpuConfig(**{
+        "inference": {"prefix_cache": {
+            "enabled": True,
+            "tiers": {"enabled": True, "host_mb": 0.5,
+                      "nvme_path": str(tmp_path), "promote_depth": 3}}}})
+    t = cfg.inference.prefix_cache.tiers
+    assert t.enabled and t.host_mb == 0.5 and t.promote_depth == 3
+    model, params = f32_lm
+    eng = _engine(model, params, prefix_cache=cfg.inference.prefix_cache)
+    try:
+        assert eng._tier_store is not None
+        assert eng._tier_store.host_bytes == int(0.5 * 2**20)
+        assert eng._tier_store.promote_depth == 3
+        assert eng._tier_store.swapper is not None
+        assert eng.prefix_cache.tier_store is eng._tier_store
+    finally:
+        eng.close()
+    assert eng._tier_store is None     # close() is the teardown seam
+
+
+def test_tiers_config_validation():
+    from deepspeed_tpu.config.config import KVTierConfig
+
+    with pytest.raises(ValueError):
+        KVTierConfig(host_mb=0)
+    with pytest.raises(ValueError):
+        KVTierConfig(promote_depth=0)
+
+
+def test_batcher_projection_counts_demoted_as_block_demand(tier_eng):
+    """Admission math: resident cached blocks are free capacity; demoted
+    blocks stay in the block projection (a promote allocates a block) but
+    the request is still a prefix hit — the promote-latency tax, not cold
+    prefill demand."""
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    eng = _reset(tier_eng)
+    b = ContinuousBatcher(eng)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 250, 52)
+    _gen(eng, 20, prompt)
+    req = type("R", (), {})()
+    req.prompt = prompt
+    req.prompt_len = len(prompt)
+    req.total_token_demand = len(prompt) + 6
+    resident_need = b._blocks_needed(req)
+    assert resident_need < b._blocks_for(req.total_token_demand)
+    eng.prefix_cache.evict(10)         # all demoted now
+    demoted_need = b._blocks_needed(req)
+    # demoted blocks cost pool blocks again (promotes allocate), so the
+    # projected need returns to the full worst case
+    assert demoted_need == b._blocks_for(req.total_token_demand)
+    eng.prefix_cache.clear()
+
+
+def test_promote_read_failure_zero_fills_and_restores_loans(tier_eng):
+    """A promote fetch failing with a NON-IO error at the fence (the lazy
+    NVMe path submits inside wait(): pool.get can raise under host-memory
+    pressure) must zero-fill that block and still finalize every other
+    record — an escape would strand the whole batch's loans and leave
+    garbage blocks attached to live sequences."""
+    eng = _reset(tier_eng)
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 250, 52)
+    _gen(eng, 30, prompt)
+    eng.prefix_cache.evict(10)
+    hit = eng.prefix_attach(31, prompt)
+    assert hit > 0 and eng._promote_q
+
+    bad = eng._promote_q[0]
+
+    class _BoomFetch:                      # KVFetch is slotted: wrap it
+        def __init__(self, inner):
+            self.inner = inner
+            self.tier = inner.tier
+            self.t_start = inner.t_start
+
+        def wait(self):
+            raise RuntimeError("pinned pool exhausted")
+
+        def release(self):
+            self.inner.release()
+
+    bad.fetch = _BoomFetch(bad.fetch)
+    misses = lambda: (eng._tier_store.counters["host_misses"]
+                      + eng._tier_store.counters["nvme_misses"])
+    m0 = misses()
+    eng._flush_promotes()                  # must not raise
+    assert not eng._promote_q
+    assert misses() == m0 + 1
+    assert not eng.prefix_cache._pending_upload
+    # the zero-filled node (and, being the chain head, everything under
+    # it) must leave the tree: published, every FUTURE match would read
+    # zeros as KV — only the in-flight acquirer computes on zeros
+    pc = eng.prefix_cache
+    assert pc.counters["tier_lost_blocks"] >= 1
+    assert pc.peek_tiers(prompt, max_tokens=len(prompt) - 1)[
+        "matched_tokens"] == 0
+    eng.flush([31])
+    eng.prefix_cache.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+    assert eng._tier_store.entries() == 0
+    assert eng._tier_store.pool.report()["outstanding"] == 0
+    assert eng._tier_store.swapper is None \
+        or eng._tier_store.swapper.report()["loaned_read_buffers"] == 0
+
+
+def test_clear_between_attach_and_fence_discards_stale_promotes(tier_eng):
+    """An ops cache flush (clear()) landing between prefix_attach and the
+    engine's next dispatch releases the promoted blocks back to the pool —
+    the fence must RELEASE the stale records, never scatter their payloads
+    over blocks that may belong to another sequence by then."""
+    eng = _reset(tier_eng)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 250, 52)
+    ref = _gen(eng, 40, prompt)
+    eng.prefix_cache.evict(10)
+    hit = eng.prefix_attach(41, prompt)
+    assert hit > 0 and eng._promote_q
+    eng.prefix_cache.clear()
+    eng.flush([41])                        # blocks fully free for reuse
+
+    orig = eng._promote_step
+
+    def must_not_scatter(*a, **kw):
+        raise AssertionError("fence scattered a stale promote")
+
+    eng._promote_step = must_not_scatter
+    try:
+        eng._flush_promotes()
+    finally:
+        eng._promote_step = orig
+    assert not eng._promote_q
+    assert eng._tier_store.pool.report()["outstanding"] == 0
+    # and the engine serves cleanly on the recycled blocks
+    assert _gen(eng, 42, prompt) == ref
+    eng.prefix_cache.clear()
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+
+
+def test_close_with_pending_promotes_drops_garbage_nodes(tier_eng):
+    """close() before the fence: the queued promotions' blocks were never
+    uploaded, and the prefix cache stays usable after a tier-only close —
+    the garbage nodes must leave the tree, not get published. (Runs LAST
+    among the tier_eng tests: it closes the shared engine.)"""
+    eng = _reset(tier_eng)
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 250, 52)
+    _gen(eng, 50, prompt)
+    eng.prefix_cache.evict(10)
+    hit = eng.prefix_attach(51, prompt)
+    assert hit > 0 and eng._promote_q
+    pc = eng.prefix_cache
+    eng.flush([51])
+    eng.close()
+    assert pc.peek_tiers(prompt, max_tokens=len(prompt) - 1)[
+        "matched_tokens"] == 0
+    assert not pc._pending_upload
+    alloc = eng.state.allocator
+    assert alloc.free_blocks == alloc.num_blocks
+    assert not alloc.leaked_blocks()
+
+
+# ---------------------------------------------------------------------------
+# drill wrappers (slow; the CLI is the invariant authority)
 # ---------------------------------------------------------------------------
 
 @pytest.mark.perf
@@ -547,4 +1126,16 @@ def test_prefix_storm_drill(tmp_path):
     from serve_drill import run_scenario
 
     verdict = run_scenario("prefix-storm", workdir=str(tmp_path))
+    assert verdict["ok"], verdict
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_kv_tier_drill(tmp_path):
+    import sys
+
+    sys.path.insert(0, _TOOLS)
+    from serve_drill import run_scenario
+
+    verdict = run_scenario("kv-tier", workdir=str(tmp_path))
     assert verdict["ok"], verdict

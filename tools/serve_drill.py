@@ -438,12 +438,134 @@ def scenario_prefix_storm(workdir):
     return ok, details
 
 
+def scenario_kv_tier(workdir):
+    """Distinct-prefix churn through a small HBM block pool with the host +
+    NVMe KV tiers on (fp32 so exactness is argmax-stable). Invariants:
+    demote→promote cycles happen in BOTH tiers (host hits and NVMe hits,
+    after demotions into each); every token stream is IDENTICAL to a
+    cache-less baseline — including the second round, where prompts are
+    served off promoted blocks; effective cache capacity (resident +
+    demoted nodes) reaches ≥ 5× the HBM pool; after flush + clear the
+    pool, the pinned-buffer pool, and the tier store are fully restored
+    with zero loans or refcounts leaked."""
+    import shutil
+    import tempfile
+
+    nvme_dir = tempfile.mkdtemp(dir=workdir) if workdir \
+        else tempfile.mkdtemp()
+    try:
+        return _kv_tier_body(nvme_dir)
+    finally:
+        # the swapper only best-effort-removes files for discarded
+        # entries; without this, every run leaks a /tmp dir of KV files
+        shutil.rmtree(nvme_dir, ignore_errors=True)
+
+
+def _kv_tier_body(nvme_dir):
+    import numpy as np
+
+    num_blocks, bs = 16, 16
+    pkw = {"preset_kw": {"dtype": "float32"}}
+    rng = np.random.default_rng(7)
+    # 30 prompts x 3 full blocks each: far more cached state than 16 HBM
+    # blocks can hold — round 1 churns the tree through demotion, round 2
+    # serves the same prompts off promoted blocks
+    prompts = [np.concatenate([rng.integers(0, 250, 48),
+                               rng.integers(0, 250, 4)])
+               for _ in range(30)]
+
+    def serve(b, ps):
+        outs = []
+        for p in ps:
+            uid = b.submit(p)
+            b.pump(max_steps=200)
+            outs.append([int(t) for t in b.manager.done[uid].generated])
+        return outs
+
+    cold = _make_batcher(num_blocks=num_blocks, engine_kw=pkw,
+                         default_max_new_tokens=6)
+    base = serve(cold, prompts)
+    base_recent = serve(cold, prompts[-8:])
+    # host budget ~10 blocks (a tiny-model block is L*bs*lanes*4B*2); the
+    # other ~70 demoted blocks must ride the NVMe tier
+    tiers = {"enabled": True, "host_mb": 10 * (2 * bs * 64 * 4 * 2) / 2**20,
+             "nvme_path": nvme_dir, "promote_depth": 4}
+    b = _make_batcher(num_blocks=num_blocks,
+                      engine_kw={**pkw,
+                                 "prefix_cache": {"enabled": True,
+                                                  "tiers": tiers}},
+                      default_max_new_tokens=6)
+    round1 = serve(b, prompts)
+    pc = b.engine.prefix_cache
+    capacity_r1 = pc.report()["blocks"] + pc.report()["demoted_nodes"]
+    # the freshest demotions are still in the host tier: replaying the
+    # most recent prompts exercises the host demote→promote cycle before
+    # their blocks age out to NVMe
+    round_recent = serve(b, prompts[-8:])
+    round2 = serve(b, prompts)
+    rep = b.serving_report()
+    pcr = pc.report()
+    tiers_rep = pcr["tiers"]
+    capacity = max(capacity_r1, pcr["blocks"] + pcr["demoted_nodes"])
+
+    alloc = b.engine.state.allocator
+    live_after = len(b.engine.state.sequences)
+    cleared = pc.clear()
+    pool_restored = alloc.free_blocks == alloc.num_blocks
+    leaked = alloc.leaked_blocks()
+    store = b.engine._tier_store
+    store_entries = store.entries()
+    pinned = store.pool.report()
+    swapper_rep = store.swapper.report() if store.swapper else {}
+    b.engine.close()
+    details = {
+        "round1_identical": round1 == base,
+        "recent_identical": round_recent == base_recent,
+        "round2_identical": round2 == base,
+        "effective_capacity_blocks": capacity,
+        "hbm_pool_blocks": num_blocks,
+        "capacity_ratio": round(capacity / num_blocks, 2),
+        "prefix_cache": pcr,
+        "tier_counters": {k: tiers_rep[k] for k in
+                          ("host_demotions", "nvme_demotions", "host_hits",
+                           "nvme_hits", "host_misses", "nvme_misses",
+                           "dropped")},
+        "batcher_tier_counters": {
+            "tier_hit_requests": rep["counters"]["tier_hit_requests"],
+            "tier_promoted_blocks":
+                rep["counters"]["tier_promoted_blocks"]},
+        "cleared_nodes": cleared, "live_sequences": live_after,
+        "pool_restored": pool_restored, "leaked_blocks": leaked,
+        "store_entries_after_clear": store_entries,
+        "pinned_pool_after_clear": pinned,
+        "swapper_after_clear": {k: swapper_rep.get(k) for k in
+                                ("inflight_tickets",
+                                 "loaned_read_buffers")},
+    }
+    ok = (round1 == base and round2 == base
+          and round_recent == base_recent
+          and tiers_rep["host_demotions"] >= 1
+          and tiers_rep["nvme_demotions"] >= 1
+          and tiers_rep["host_hits"] >= 1
+          and tiers_rep["nvme_hits"] >= 1
+          and pcr["promoted_blocks"] >= 1
+          and rep["counters"]["tier_promoted_blocks"] >= 1
+          and capacity >= 5 * num_blocks
+          and live_after == 0 and pool_restored and not leaked
+          and store_entries == 0
+          and pinned["outstanding"] == 0
+          and swapper_rep.get("inflight_tickets", 0) == 0
+          and swapper_rep.get("loaned_read_buffers", 0) == 0)
+    return ok, details
+
+
 SCENARIOS = {
     "deadline-storm": scenario_deadline_storm,
     "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
     "sigterm-drain": scenario_sigterm_drain,
     "frontend-storm": scenario_frontend_storm,
     "prefix-storm": scenario_prefix_storm,
+    "kv-tier": scenario_kv_tier,
 }
 
 
