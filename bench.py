@@ -182,6 +182,22 @@ def main() -> None:
             result["extra"]["offload"] = {"error": str(e)[:200]}
 
     print(json.dumps(result))
+    _ledger(result, "bench")
+
+
+def _ledger(result, bench):
+    """Append to the perf-trend ledger (tools/bench_ledger.jsonl) —
+    best-effort; the ledger must never sink the headline."""
+    import os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from bench_ledger import append_ledger
+
+        append_ledger(result, bench)
+    except Exception:
+        pass
 
 
 def bench_offload(ds, TransformerLM, TransformerConfig, steps: int = 5):

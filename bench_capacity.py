@@ -115,8 +115,15 @@ def main():
         if "error" in r:
             break
         best = r
-    print(json.dumps({"metric": "zero_infinity_capacity_per_chip",
-                      "best": best, "trials": results}))
+    result = {"metric": "zero_infinity_capacity_per_chip",
+              "best": best, "trials": results}
+    print(json.dumps(result))
+    try:  # perf-trend ledger (best-effort; never sinks the bench)
+        from bench import _ledger
+
+        _ledger(result, "bench_capacity")
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

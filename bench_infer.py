@@ -622,6 +622,12 @@ def run_inference_bench(cfg=None,
 def main() -> None:
     result = {"metric": "serving_bench", **run_inference_bench()}
     print(json.dumps(result))
+    try:  # perf-trend ledger (best-effort; never sinks the bench)
+        from bench import _ledger
+
+        _ledger(result, "bench_infer")
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

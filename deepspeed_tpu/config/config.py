@@ -605,6 +605,11 @@ class ServingConfig(DSTpuConfigModel):
     # reads per step; no device syncs). Gates ONLY the span histograms:
     # lifecycle counters (terminals/sheds/rejects) always record.
     trace_requests: bool = True
+    # terminal ledger bound: oldest terminal requests are evicted past
+    # this (their spans retained in the flight recorder when tracing is
+    # on) so a long-running replica's per-request state stays bounded —
+    # the manager-side mirror of serving.router.max_route_history
+    max_done_history: int = 65536
     frontend: FrontendConfig = Field(default_factory=FrontendConfig)
     router: RouterConfig = Field(default_factory=RouterConfig)
 
@@ -623,6 +628,8 @@ class ServingConfig(DSTpuConfigModel):
         if self.prefill_chunk < 1 or self.max_queue_depth < 1:
             raise ValueError("serving: prefill_chunk and max_queue_depth "
                              "must be >= 1")
+        if self.max_done_history < 1:
+            raise ValueError("serving.max_done_history must be >= 1")
         return self
 
 
@@ -740,6 +747,44 @@ class ProfileTriggerConfig(DSTpuConfigModel):
                                       # (jit compile exemption)
 
 
+class TracingConfig(DSTpuConfigModel):
+    """``observability.tracing``: the causal event bus + crash flight
+    recorder (``deepspeed_tpu/observability/events.py`` / ``trace.py``).
+    Typed begin/end/instant/async events with monotonic timestamps and a
+    ``trace_id`` causal chain flow from every async seam (serving
+    lifecycle, batcher steps, engine put/decode/spec rounds, KV-tier
+    promotes, AIO swap tickets, checkpoint commit stages, fleet
+    decisions) into bounded per-category rings; ``GET /v1/trace`` exports
+    Chrome-trace JSON, and StepGuard aborts / watchdog escalations /
+    CoordinatedAbort / SIGTERM emergency saves / batcher DEGRADED
+    transitions dump the rings to a timestamped flight-recorder file.
+    Off by default; when off the cost is one attribute check per
+    instrumented site and nothing is recorded."""
+
+    enabled: bool = False
+    # events kept per category (a deque maxlen — drops oldest, never grows)
+    ring_size: int = 4096
+    # keep every Nth request trace (1 = all); deterministic count-based
+    # sampling so drills can assert exact behavior
+    sample: int = 1
+    dump_dir: str = "./flight_dumps"
+    # terminal request spans retained after the serving ledger evicts the
+    # uid, so request_trace(uid) still resolves post-mortem
+    retain_terminal: int = 256
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.ring_size < 16:
+            raise ValueError("observability.tracing.ring_size must be "
+                             ">= 16")
+        if self.sample < 1:
+            raise ValueError("observability.tracing.sample must be >= 1")
+        if self.retain_terminal < 0:
+            raise ValueError("observability.tracing.retain_terminal must "
+                             "be >= 0")
+        return self
+
+
 class ObservabilityConfig(DSTpuConfigModel):
     """``observability`` section: the unified metrics/tracing/profiling
     substrate (``deepspeed_tpu/observability``) — the process-wide
@@ -761,6 +806,7 @@ class ObservabilityConfig(DSTpuConfigModel):
     monitor_memory: bool = False      # host memory on the periodic speed log
     profile: ProfileTriggerConfig = Field(
         default_factory=ProfileTriggerConfig)
+    tracing: TracingConfig = Field(default_factory=TracingConfig)
 
 
 class AioConfig(DSTpuConfigModel):

@@ -27,6 +27,7 @@ import numpy as np
 from deepspeed_tpu.inference.quant import QUANT_LEAVES
 from deepspeed_tpu.inference.ragged import (CapacityError, PrefixCache,
                                             SequenceManager)
+from deepspeed_tpu.observability.events import get_bus
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -105,6 +106,10 @@ class InferenceEngineV2:
         self.params = params
         self.timing: Dict[str, float] = {}
         self._obs = None  # opt-in inference/* registry stream; enable_metrics
+        # causal event bus (observability.tracing) — cached ref; the
+        # singleton is mutated in place by configure_tracing, so a
+        # disabled bus costs one attribute check per dispatch
+        self._ebus = get_bus()
         self.block_size = block_size
         self.nb_max = -(-self.max_seq_len // block_size)  # logical blocks/slot
         if kv_dtype not in ("bf16", "int8", "int4"):
@@ -349,6 +354,17 @@ class InferenceEngineV2:
         self._pos[seq.slot] = n
         if self._hist is not None:
             self._hist[uid] = toks[:n].copy()
+        bus = self._ebus
+        if bus.enabled and (n or recs):
+            # the uid <-> KV-tier join point: a warm-but-demoted prefix
+            # attaching here is the event that explains a cheap TTFT
+            bus.instant("engine", "prefix_attach",
+                        args={"uid": int(uid), "hit_tokens": int(n),
+                              "promotes": len(recs)})
+            if recs:
+                bus.instant("kv_tier", "promote_attach",
+                            args={"uid": int(uid), "blocks": len(recs),
+                                  "tiers": sorted({r.tier for r in recs})})
         return n
 
     def _commit(self, uid: int, fed) -> None:
@@ -411,6 +427,14 @@ class InferenceEngineV2:
         recs, self._promote_q = self._promote_q, []
         if not recs:
             return
+        bus = self._ebus
+        if not bus.enabled:
+            return self._flush_promotes_impl(recs)
+        with bus.span("engine", "promote_fence",
+                      args={"pending": len(recs)}):
+            return self._flush_promotes_impl(recs)
+
+    def _flush_promotes_impl(self, recs) -> None:
         stale = [r for r in recs if r.epoch != self.prefix_cache.epoch]
         if stale:
             # a clear() between attach and this fence released these
@@ -640,6 +664,20 @@ class InferenceEngineV2:
         Sampling always takes the fused-scan path."""
         spec = (self.spec_cfg.enabled if speculative is None
                 else bool(speculative))
+        bus = self._ebus
+        if bus.enabled:
+            with bus.span("engine", "decode_batch",
+                          args={"uids": [int(u) for u in batch_uids],
+                                "steps": int(steps), "spec": spec}):
+                return self._decode_batch_dispatch(
+                    batch_uids, batch_tokens, steps, temperature, top_k,
+                    top_p, seed, spec)
+        return self._decode_batch_dispatch(batch_uids, batch_tokens, steps,
+                                           temperature, top_k, top_p, seed,
+                                           spec)
+
+    def _decode_batch_dispatch(self, batch_uids, batch_tokens, steps,
+                               temperature, top_k, top_p, seed, spec):
         if spec and temperature == 0.0 and self._hist is not None:
             return self._decode_batch_spec(batch_uids, batch_tokens, steps)
         return self._decode_batch_scan(batch_uids, batch_tokens, steps,
@@ -782,6 +820,15 @@ class InferenceEngineV2:
         return tok_ids, tok_slot, tok_pos, valid, starts, dr, tile, no_past
 
     def _spec_verify(self, batch_uids, batch_tokens, drafts):
+        bus = self._ebus
+        if not bus.enabled:
+            return self._spec_verify_impl(batch_uids, batch_tokens, drafts)
+        with bus.span("engine", "spec_verify",
+                      args={"uids": [int(u) for u in batch_uids],
+                            "drafted": int(sum(len(d) for d in drafts))}):
+            return self._spec_verify_impl(batch_uids, batch_tokens, drafts)
+
+    def _spec_verify_impl(self, batch_uids, batch_tokens, drafts):
         """Verify per-sequence chunks ``[t0, d1..dk]`` in one packed step
         with logits gathered at EVERY chunk position, then accept greedily.
         KV for rejected drafts lands in the pool but the frontier
@@ -1002,6 +1049,20 @@ class InferenceEngineV2:
         ragged in effect while dense in shape. With ``inference.prefix_cache``
         enabled, a fresh multi-token chunk first attaches any cached
         full-block prefix and only its uncached suffix is prefilled."""
+        bus = self._ebus
+        if not bus.enabled:
+            return self._put_impl(batch_uids, batch_tokens)
+        # the span carries the uid list: the request-track async events
+        # join to these engine steps by uid (trace_drill's chain check)
+        with bus.span("engine", "put", args={
+                "uids": [int(u) for u in batch_uids],
+                "tokens": int(sum(np.atleast_1d(np.asarray(t)).size
+                                  for t in batch_tokens))}):
+            return self._put_impl(batch_uids, batch_tokens)
+
+    def _put_impl(self, batch_uids: Sequence[int],
+                  batch_tokens: Sequence[np.ndarray]
+                  ) -> Dict[int, np.ndarray]:
         assert len(batch_uids) == len(batch_tokens)
         t_put = time.perf_counter()
         self.timing = {}        # never report a previous put's numbers

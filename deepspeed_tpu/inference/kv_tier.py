@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability.events import get_bus
 from deepspeed_tpu.offload.swap import AsyncTensorSwapper, PinnedBufferPool
 from deepspeed_tpu.utils.logging import logger
 
@@ -70,7 +71,7 @@ class KVFetch:
     ``wait()`` at the engine's fence instead of up front."""
 
     __slots__ = ("store", "entry", "tier", "t_start", "_ticket", "_lazy",
-                 "_parts", "_released")
+                 "_parts", "_released", "eid")
 
     def __init__(self, store: "KVTierStore", entry: _Entry, tier: str,
                  ticket=None, lazy: bool = False):
@@ -82,6 +83,15 @@ class KVFetch:
         self._lazy = lazy
         self._parts: Optional[Dict[str, np.ndarray]] = None
         self._released = False
+        # async event-track id: fetch_start -> release is the promote's
+        # in-flight window on the trace timeline
+        self.eid: Optional[int] = None
+        bus = store._ebus
+        if bus.enabled:
+            self.eid = bus.new_id()
+            bus.async_begin("kv_tier", "kv_fetch", self.eid,
+                            args={"key": entry.key, "tier": tier,
+                                  "bytes": entry.nbytes, "lazy": lazy})
 
     @property
     def submitted(self) -> bool:
@@ -115,6 +125,11 @@ class KVFetch:
             return
         self._released = True
         self._parts = None
+        bus = self.store._ebus
+        if self.eid is not None and bus.enabled:
+            bus.async_end("kv_tier", "kv_fetch", self.eid,
+                          args={"tier": self.tier})
+            self.eid = None
         if self.tier == TIER_NVME and self._ticket is not None:
             self.store._reads_inflight -= 1
             try:
@@ -174,6 +189,7 @@ class KVTierStore:
             self.swapper = None
         self.on_drop = on_drop
         self._inst = instruments or {}
+        self._ebus = get_bus()   # causal event bus (mutated in place)
         self._host: "OrderedDict[int, _Entry]" = OrderedDict()
         self._nvme: Dict[int, _Entry] = {}
         self._host_used = 0
@@ -241,6 +257,10 @@ class KVTierStore:
         self._host[key] = entry
         self._host_used += off
         self._count(TIER_HOST, "demotions")
+        if self._ebus.enabled:
+            self._ebus.instant("kv_tier", "demote",
+                               args={"key": key, "bytes": off,
+                                     "tier": TIER_HOST})
         self._spill(protect=key)
         self._set_bytes()
         return True
@@ -281,10 +301,17 @@ class KVTierStore:
             self._nvme[key] = e
             self._nvme_used += e.nbytes
             self._count(TIER_NVME, "demotions")
+            if self._ebus.enabled:
+                self._ebus.instant("kv_tier", "spill",
+                                   args={"key": key, "bytes": e.nbytes,
+                                         "tier": TIER_NVME})
 
     def _drop_entry(self, e: _Entry, tier: str) -> None:
         self.counters["dropped"] += 1
         self._count(tier, "misses")
+        if self._ebus.enabled:
+            self._ebus.instant("kv_tier", "drop",
+                               args={"key": e.key, "tier": tier})
         if e.buf is not None:
             self.pool.put(e.buf)
             e.buf = None

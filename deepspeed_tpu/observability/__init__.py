@@ -23,7 +23,16 @@ serving stack the ROADMAP grows next:
   restart;
 * :mod:`~deepspeed_tpu.observability.bridge` — :class:`MonitorBridge`:
   periodic registry-delta flush through the existing ``MonitorMaster`` so
-  CSV/TensorBoard/wandb/comet dashboards keep working unchanged.
+  CSV/TensorBoard/wandb/comet dashboards keep working unchanged;
+* :mod:`~deepspeed_tpu.observability.events` — the causal event bus:
+  typed begin/end/instant/async events with monotonic timestamps, thread
+  ids, and a ``trace_id`` chain, emitted from every async seam into
+  bounded per-category rings (``observability.tracing`` config);
+* :mod:`~deepspeed_tpu.observability.trace` — the bus's consumers:
+  ``trace_export()`` (Chrome-trace/Perfetto JSON, served at
+  ``GET /v1/trace``) and the :class:`FlightRecorder` black box dumped on
+  StepGuard aborts, watchdog escalations, coordinated aborts, emergency
+  saves, and DEGRADED transitions.
 
 Metric name schema: ``serving/*`` (request lifecycle + SLOs),
 ``train/*`` (per-step breakdown), ``resilience/*`` (checkpoint/guard),
@@ -31,6 +40,9 @@ Metric name schema: ``serving/*`` (request lifecycle + SLOs),
 """
 
 from deepspeed_tpu.observability.bridge import MonitorBridge
+from deepspeed_tpu.observability.events import (EventBus, TraceEvent,
+                                                configure_tracing, get_bus,
+                                                set_bus)
 from deepspeed_tpu.observability.exposition import (LIVE_STATES,
                                                     READY_STATES,
                                                     ObservabilityServer,
@@ -41,12 +53,18 @@ from deepspeed_tpu.observability.registry import (Counter, Gauge, Histogram,
                                                   MetricsRegistry,
                                                   exponential_bounds,
                                                   get_registry, set_registry)
+from deepspeed_tpu.observability.trace import (FlightRecorder, flight_dump,
+                                               get_flight_recorder,
+                                               set_flight_recorder,
+                                               trace_export, validate_trace)
 from deepspeed_tpu.observability.tracing import HEALTH_CODES, ServingMetrics
 
 __all__ = [
-    "Counter", "Gauge", "HEALTH_CODES", "Histogram", "HistogramWindow",
-    "LIVE_STATES", "MetricsRegistry", "MonitorBridge",
-    "ObservabilityServer", "ProfileTrigger", "READY_STATES",
-    "ServingMetrics", "exponential_bounds", "get_registry", "probe_status",
-    "set_registry",
+    "Counter", "EventBus", "FlightRecorder", "Gauge", "HEALTH_CODES",
+    "Histogram", "HistogramWindow", "LIVE_STATES", "MetricsRegistry",
+    "MonitorBridge", "ObservabilityServer", "ProfileTrigger",
+    "READY_STATES", "ServingMetrics", "TraceEvent", "configure_tracing",
+    "exponential_bounds", "flight_dump", "get_bus", "get_flight_recorder",
+    "get_registry", "probe_status", "set_bus", "set_flight_recorder",
+    "set_registry", "trace_export", "validate_trace",
 ]

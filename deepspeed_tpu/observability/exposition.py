@@ -130,6 +130,17 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/metrics.json":
                 self._send(200, srv.registry.render_json(),
                            "application/json")
+            elif path == "/v1/trace":
+                # causal event trace (Chrome trace-event JSON): open the
+                # download in Perfetto / chrome://tracing. Served on every
+                # ObservabilityServer, so the trace rides the same port as
+                # /metrics and the serving API
+                from deepspeed_tpu.observability.trace import trace_export
+
+                self._send(200, json.dumps(trace_export(), default=str),
+                           "application/json",
+                           headers={"Content-Disposition":
+                                    'attachment; filename="trace.json"'})
             elif path in ("/healthz", "/readyz"):
                 st = probe_status(srv.health_fn()
                                   if srv.health_fn is not None else None)

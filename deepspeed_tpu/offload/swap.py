@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability.events import get_bus
 from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
 from deepspeed_tpu.utils.logging import logger
 
@@ -142,7 +143,7 @@ class SwapTicket:
 
     __slots__ = ("swapper", "tid", "kind", "name", "op_ids", "buf", "nbytes",
                  "shape", "dtype", "t_submit", "_done", "_released", "_view",
-                 "_failed")
+                 "_failed", "_eid")
 
     def __init__(self, swapper: "AsyncTensorSwapper", tid: int, kind: str,
                  name: str, op_ids: List[int], buf: PinnedBuffer, nbytes: int,
@@ -161,6 +162,27 @@ class SwapTicket:
         self._released = False
         self._failed = False   # a reaped chunk errored (sticky across polls)
         self._view: Optional[np.ndarray] = None
+        # async event-track id (observability.tracing): submit -> reap is
+        # the op's in-flight window on the trace timeline
+        self._eid: Optional[int] = None
+        bus = get_bus()
+        if bus.enabled:
+            self._eid = bus.new_id()
+            bus.async_begin("aio", "swap_op", self._eid,
+                            args={"kind": kind, "name": name,
+                                  "bytes": nbytes,
+                                  "chunks": len(op_ids)})
+
+    def _emit_end(self, error: bool, barrier: bool = False) -> None:
+        """Close the ticket's async event track exactly once."""
+        if self._eid is None:
+            return
+        bus = get_bus()
+        if bus.enabled:
+            bus.async_end("aio", "swap_op", self._eid,
+                          args={"kind": self.kind, "error": error,
+                                "barrier": barrier})
+        self._eid = None
 
     @property
     def done(self) -> bool:
@@ -205,6 +227,7 @@ class SwapTicket:
         sw = self.swapper
         sw._inflight.pop(self.tid, None)
         elapsed_ms = (time.perf_counter() - self.t_submit) * 1e3
+        self._emit_end(failed)
         if failed:
             self._release_buf()
             sw._record_io(self.kind, self.nbytes, elapsed_ms, error=True)
@@ -481,6 +504,7 @@ class AsyncTensorSwapper:
         for t in list(self._inflight.values()):
             t.op_ids = []          # reaped by the barrier
             t._done = True
+            t._emit_end(bool(errors or t._failed), barrier=True)
             if errors or t._failed:
                 # t._failed: a chunk failure already reaped by poll() (the
                 # native error counter was decremented there) — it must not
@@ -520,6 +544,7 @@ class AsyncTensorSwapper:
         for t in list(self._inflight.values()) + list(self._loans.values()):
             t.op_ids = []
             t._done = True
+            t._emit_end(True, barrier=True)
             t._view = None
             t._release_buf()
         self._inflight.clear()

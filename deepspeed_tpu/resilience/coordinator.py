@@ -203,6 +203,15 @@ class ResilienceCoordinator:
             key = "saves_agreed" if agreed == SAVE else "aborts_agreed"
             with self._lock:
                 self.counters[key] += 1
+            from deepspeed_tpu.observability.events import get_bus
+
+            bus = get_bus()
+            if bus.enabled:
+                bus.instant("resilience", "fleet_decision",
+                            args={"decision": DECISION_NAMES[agreed],
+                                  "step": int(step),
+                                  "local": DECISION_NAMES[code],
+                                  "reason": self.last_reason[:400]})
             logger.warning(
                 f"resilience coordinator: fleet agreed "
                 f"{DECISION_NAMES[agreed]} at step {step} "

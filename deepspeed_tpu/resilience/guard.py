@@ -133,6 +133,16 @@ class StepGuard:
         if not calibrating:
             self.consecutive_bad += 1
         self.counters["bad_steps_skipped"] += 1
+        from deepspeed_tpu.observability.events import get_bus
+
+        bus = get_bus()
+        if bus.enabled:
+            # these instants are what the flight dump of a later abort
+            # carries: the skipped steps leading up to the budget
+            bus.instant("resilience", "bad_step",
+                        args={"step": int(eng.global_steps),
+                              "consecutive": self.consecutive_bad,
+                              "calibrating": calibrating})
         logger.error(
             f"step guard: non-finite loss/grads at step {eng.global_steps} "
             f"(gnorm={float(gnorm)}, consecutive={self.consecutive_bad}, "
@@ -149,7 +159,8 @@ class StepGuard:
         eng._finish_step(jnp.float32(float(gnorm)), jnp.asarray(True))
 
     def abort(self, reason: str) -> None:
-        """Write the report (if a checkpoint dir is known) and escalate."""
+        """Write the report (if a checkpoint dir is known), dump the
+        flight recorder, and escalate."""
         self.counters["aborts"] += 1
         eng = self.engine
         report_dir = getattr(eng, "_resilience_report_dir", None)
@@ -158,5 +169,19 @@ class StepGuard:
                 eng.write_resilience_report(report_dir)
             except OSError as e:
                 logger.error(f"could not write resilience report: {e}")
+        from deepspeed_tpu.observability.events import get_bus
+        from deepspeed_tpu.observability.trace import flight_dump
+
+        step = int(getattr(eng, "global_steps", -1))
+        bus = get_bus()
+        if bus.enabled:
+            bus.instant("resilience", "stepguard_abort",
+                        args={"step": step, "reason": reason})
+        # keyed per step: the abort may surface via guard.abort AND the
+        # coordinated-abort path for the same incident — one black box
+        flight_dump("stepguard_abort",
+                    extra={"step": step, "reason": reason,
+                           "counters": dict(self.counters)},
+                    key=f"abort-step{step}")
         logger.error(f"step guard aborting to the elastic agent: {reason}")
         raise TooManyBadSteps(reason)

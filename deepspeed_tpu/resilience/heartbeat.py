@@ -262,6 +262,21 @@ class HangWatchdog:
         return cause
 
     def _escalate(self) -> None:
+        from deepspeed_tpu.observability.events import get_bus
+        from deepspeed_tpu.observability.trace import flight_dump
+
+        bus = get_bus()
+        if bus.enabled:
+            bus.instant("resilience", "hang_escalation",
+                        args={"policy": self.on_hang,
+                              "cause": self.last_cause[:400]})
+        # the black box of "what was in flight when the watchdog fired" —
+        # keyed per detection so a re-armed later hang dumps again while
+        # one incident never dumps twice
+        flight_dump("hang_watchdog",
+                    extra={"cause": self.last_cause, "policy": self.on_hang,
+                           "counters": dict(self.counters)},
+                    key=f"hang-{int(self.counters['hangs_detected'])}")
         if self.coordinator is not None:
             self.coordinator.signal_abort(f"hang: {self.last_cause}")
         if self.on_hang == "exit":

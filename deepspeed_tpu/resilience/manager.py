@@ -192,6 +192,13 @@ class CheckpointManager:
 
         path = retry_call(_save, policy=self.retry_policy,
                           what=f"checkpoint save ({tag})", on_retry=_on_retry)
+        from deepspeed_tpu.observability.events import get_bus
+
+        _bus = get_bus()
+        if _bus.enabled:
+            _bus.instant("checkpoint", "staged",
+                         args={"tag": tag, "step": global_steps,
+                               "async": use_async, "emergency": emergency})
 
         def _commit():
             # the window between stage and this point is the crash drill:
@@ -232,6 +239,13 @@ class CheckpointManager:
                 retry_call(_latest_io, policy=self.retry_policy,
                            what=f"checkpoint latest ({tag})",
                            on_retry=_on_retry)
+            if _bus.enabled:
+                # committed = manifest written + latest flipped: the stage
+                # -> commit gap on the timeline IS the async-save window
+                _bus.instant("checkpoint", "committed",
+                             args={"tag": tag, "step": global_steps,
+                                   "async": use_async,
+                                   "emergency": emergency})
 
         if use_async:
             error_box: list = []
@@ -473,5 +487,11 @@ class CheckpointManager:
         self.preempted = False
         tag = f"preempt_step{engine.global_steps}"
         path = self.save(engine, tag=tag, emergency=True)
+        from deepspeed_tpu.observability.trace import flight_dump
+
+        # the black box rides the preemption artifact: what was in flight
+        # when SIGTERM landed (keyed per tag — one dump per preemption)
+        flight_dump("emergency_save", extra={"tag": tag, "path": path},
+                    key=f"emergency-{tag}")
         logger.warning(f"emergency checkpoint saved to {path}")
         return path

@@ -1148,8 +1148,11 @@ class DeepSpeedTpuEngine:
         ``float(loss)`` in the monitor write, stay the only syncs)."""
         from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
 
+        from deepspeed_tpu.observability.events import get_bus
+
         ocfg = config.observability
         self.wall_timers = SynchronizedWallClockTimer()
+        self._ebus = get_bus()
         self._obs = None
         self._obs_bridge = None
         self._obs_server = None
@@ -1164,6 +1167,13 @@ class DeepSpeedTpuEngine:
         from deepspeed_tpu.comm.logger import comms_logger
 
         self._comm_lat_base = comms_logger.total_latency_s()
+        if ocfg.tracing.enabled:
+            # causal event tracing + crash flight recorder: applied to the
+            # process bus in place, so every already-constructed seam
+            # (serving, engine, swap, resilience) starts emitting
+            from deepspeed_tpu.observability import configure_tracing
+
+            configure_tracing(ocfg.tracing)
         if not ocfg.enabled:
             return
         from deepspeed_tpu.observability import (MonitorBridge,
@@ -1214,6 +1224,11 @@ class DeepSpeedTpuEngine:
         o["steps"].set(float(self.global_steps))
         o["samples"].set(float(self.global_samples))
         o["skipped_steps"].set(float(self.skipped_steps))
+        if self._ebus.enabled:
+            # one instant per committed step: the training heartbeat the
+            # flight recorder shows around an abort (host clock only)
+            self._ebus.instant("train", "step",
+                               args={"step": int(self.global_steps)})
         if self._opt_ms is not None:
             o["optimizer_ms"].set(self._opt_ms)
             self._opt_ms = None
@@ -1319,6 +1334,12 @@ class DeepSpeedTpuEngine:
         tag = f"preempt_step{self.global_steps}"
         path = mgr.save(self, tag=tag, emergency=True,
                         decision=coord.decision_record())
+        from deepspeed_tpu.observability import flight_dump
+
+        flight_dump("emergency_save",
+                    extra={"tag": tag, "path": path,
+                           "decision": coord.decision_record()},
+                    key=f"emergency-{tag}")
         logger.warning(f"coordinated emergency checkpoint saved to {path}")
         if self.monitor is not None:
             self.monitor.write_events(
@@ -1346,6 +1367,14 @@ class DeepSpeedTpuEngine:
                 self.write_resilience_report(self._resilience_report_dir)
             except OSError as e:
                 logger.error(f"could not write resilience report: {e}")
+        from deepspeed_tpu.observability import flight_dump
+
+        # same per-step key as guard.abort: whichever layer surfaces the
+        # incident first ships the one black box
+        flight_dump("coordinated_abort",
+                    extra={"step": int(self.global_steps),
+                           "reason": reason},
+                    key=f"abort-step{int(self.global_steps)}")
         logger.error(f"coordinated abort to the elastic agent: {reason}")
         raise CoordinatedAbort(reason)
 

@@ -52,6 +52,8 @@ import numpy as np
 from deepspeed_tpu.inference.ragged import CapacityError
 from deepspeed_tpu.observability import (HEALTH_CODES, HistogramWindow,
                                          MonitorBridge, ServingMetrics)
+from deepspeed_tpu.observability.events import get_bus
+from deepspeed_tpu.observability.trace import flight_dump
 from deepspeed_tpu.resilience.faults import InjectedIOError, get_injector
 from deepspeed_tpu.serving.manager import RequestManager
 from deepspeed_tpu.serving.request import DECODING, PREFILLING, ServeRequest
@@ -64,6 +66,10 @@ STARTING, READY, DEGRADED, DRAINING = ("starting", "ready", "degraded",
 
 
 class ContinuousBatcher:
+    #: flight dumps written for DEGRADED entries, lifetime cap (see
+    #: _update_health — a health flap must not become a disk-filler)
+    MAX_DEGRADED_DUMPS = 8
+
     def __init__(self, engine, config=None, monitor=None,
                  clock: Callable[[], float] = time.monotonic,
                  manager: Optional[RequestManager] = None,
@@ -102,7 +108,11 @@ class ContinuousBatcher:
                 default_max_new_tokens=self.cfg.default_max_new_tokens,
                 default_deadline_s=self.cfg.default_deadline_s,
                 retry_after_s=self.cfg.retry_after_s,
-                clock=clock, metrics=self.metrics)
+                clock=clock, metrics=self.metrics,
+                max_done_history=self.cfg.max_done_history)
+        # causal event bus (observability.tracing) — cached ref; the
+        # singleton is mutated in place by configure_tracing
+        self._ebus = get_bus()
         self.manager.release_fn = lambda uids: self.engine.flush(uids)
         self.health = STARTING
         self.drained = False
@@ -365,6 +375,12 @@ class ContinuousBatcher:
         """Record one generated token; returns True if the request reached a
         terminal state (eos / length)."""
         req.generated.append(nxt)
+        if len(req.generated) == 1 and req.trace_id is not None \
+                and self._ebus.enabled:
+            self._ebus.async_instant(
+                "request", "request", req.trace_id,
+                args={"subsys": "batcher", "what": "first_token",
+                      "uid": req.uid})
         if self._trace:
             now = self.clock()
             if req.first_token_at is None:
@@ -396,6 +412,11 @@ class ContinuousBatcher:
             if req.prefilled < req.prompt_len:
                 return
             req.state = DECODING
+            if req.trace_id is not None and self._ebus.enabled:
+                self._ebus.async_instant(
+                    "request", "request", req.trace_id,
+                    args={"subsys": "batcher", "what": "prefill_done",
+                          "uid": req.uid, "prefilled": req.prefilled})
         else:
             self.counters["decode_tokens"] += 1
         self._emit_token(req, int(np.argmax(np.asarray(logits))))
@@ -411,6 +432,17 @@ class ContinuousBatcher:
 
     def step(self) -> bool:
         """One serving iteration; returns True if an engine step ran."""
+        bus = self._ebus
+        if not bus.enabled:
+            return self._step_impl()
+        # the span's with-block guarantees the E lands on every exit path
+        # (the dslint event-span discipline); engine put/spec spans nest
+        # inside it on this thread, giving the per-step causal stack
+        with bus.span("batcher", "step", args={"step": self.steps,
+                                               "health": self.health}):
+            return self._step_impl()
+
+    def _step_impl(self) -> bool:
         t0 = self.clock()
         if self._drain_requested.is_set() and self.health != DRAINING:
             self.begin_drain("SIGTERM")
@@ -564,6 +596,22 @@ class ContinuousBatcher:
                     f"serving: DEGRADED (failure ratio {ratio:.2f} over "
                     f"last {len(window)} steps); capacity reduced to "
                     f"{self.cfg.degraded_capacity_factor:.0%}")
+                if self._ebus.enabled:
+                    self._ebus.instant("batcher", "degraded",
+                                       args={"step": self.steps,
+                                             "failure_ratio": ratio})
+                # black-box the window that degraded us: the last N steps'
+                # events are exactly what the operator needs to see. Capped:
+                # a replica flapping READY<->DEGRADED on borderline load
+                # must not fill the disk with a dump per oscillation — the
+                # first few black boxes tell the story, the counters and
+                # the degraded instant keep telling it after
+                if self.counters["degraded_entries"] \
+                        <= self.MAX_DEGRADED_DUMPS:
+                    flight_dump(
+                        "batcher_degraded",
+                        extra={"step": self.steps, "failure_ratio": ratio},
+                        key=f"degraded-{self.counters['degraded_entries']}")
             elif self.health == DEGRADED \
                     and ratio <= self.cfg.degrade_failure_ratio / 2:
                 self.health = READY
@@ -590,6 +638,10 @@ class ContinuousBatcher:
             return
         self.health = DRAINING
         self.drain_reason = reason
+        if self._ebus.enabled:
+            self._ebus.instant("batcher", "drain_begin",
+                               args={"reason": reason, "step": self.steps,
+                                     "in_flight": len(self.manager.active)})
         self.manager.close(reason)
         for req in list(self.manager.queue):
             self.manager.shed(req, "draining")

@@ -38,6 +38,7 @@ import socket
 import time
 from typing import Dict, Optional
 
+from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
 from deepspeed_tpu.serving import protocol
 from deepspeed_tpu.serving.protocol import (GENERATE_PATH, STATE_PATH,
                                             GenerateRequest, ProtocolError,
@@ -170,19 +171,49 @@ class ServingFrontend:
             self._send_json(handler, e.status, e.body())
             return
         events: "queue.Queue" = queue.Queue()
+        # mint the request's causal trace id HERE — the front door — so
+        # the same track links frontend -> router -> batcher -> engine ->
+        # KV tier (the manager adopts it instead of minting its own)
+        bus = get_bus()
+        trace_id = bus.mint_trace() if bus.enabled else None
+        # trace_id rides the submit chain ONLY when tracing is on: with
+        # tracing off the backend duck-type contract stays the pre-tracing
+        # one (submit(prompt, *, max_new_tokens, deadline_s, priority,
+        # events)). A sampled-out request passes the SAMPLED_OUT sentinel
+        # so the manager does not mint again (each request gets exactly
+        # one 1-in-N draw, at the front door).
+        extra = ({} if not bus.enabled else
+                 {"trace_id": trace_id if trace_id is not None
+                  else SAMPLED_OUT})
         try:
             uid = self.backend.submit(
                 preq.prompt, max_new_tokens=preq.max_new_tokens,
                 deadline_s=preq.deadline_s, priority=preq.priority,
-                events=events)
+                events=events, **extra)
         except ShedError as e:
+            if trace_id is not None:
+                bus.instant("frontend", "rejected",
+                            trace_id=trace_id,
+                            args={"reason": e.reason,
+                                  "retryable": e.retryable})
             status, headers, body = shed_response(e)
             self._send_json(handler, status, body, headers=headers)
             return
+        if trace_id is not None:
+            # async instant on the request track: the admit hop is now
+            # causally pinned to this HTTP exchange
+            bus.async_instant("request", "request", trace_id,
+                              args={"subsys": "frontend",
+                                    "what": "http_admit", "uid": uid,
+                                    "stream": preq.stream})
         if preq.stream:
             self._stream_response(handler, uid, events, preq)
         else:
             self._unary_response(handler, uid, events, preq)
+        if trace_id is not None:
+            bus.async_instant("request", "request", trace_id,
+                              args={"subsys": "frontend",
+                                    "what": "http_done", "uid": uid})
 
     # ------------------------------------------------------------------
     # response modes

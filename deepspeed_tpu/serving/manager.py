@@ -16,16 +16,23 @@ and :meth:`resolve` answers for any uid ever submitted.
 
 from __future__ import annotations
 
+import itertools
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
+from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
 from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
                                            EXPIRED, PREFILLING, QUEUED, SHED,
                                            ServeRequest, ShedError, as_prompt)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["RequestManager"]
+
+# per-manager namespace for flight-recorder terminal-span keys: every
+# manager numbers uids from 0, so a co-resident replica's uid 5 must not
+# answer for THIS manager's uid 5 in the process-global recorder
+_LEDGER_NS = itertools.count(1)
 
 
 class RequestManager:
@@ -35,7 +42,7 @@ class RequestManager:
                  retry_after_s: float = 1.0,
                  release_fn: Optional[Callable[[Sequence[int]], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 metrics=None):
+                 metrics=None, max_done_history: int = 65536):
         self.max_queue_depth = int(max_queue_depth)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_deadline_s = default_deadline_s
@@ -53,7 +60,24 @@ class RequestManager:
         self.metrics = metrics
         self.queue: Deque[ServeRequest] = deque()
         self.active: Dict[int, ServeRequest] = {}   # admitted, on the engine
-        self.done: Dict[int, ServeRequest] = {}     # terminal ledger
+        # terminal ledger, BOUNDED: oldest terminals are evicted past
+        # max_done_history with their span handed to the flight recorder
+        # (when tracing is on) so request_trace(uid) still answers for a
+        # post-mortem — an unbounded ledger was a slow per-request leak on
+        # a long-running replica
+        self.done: "OrderedDict[int, ServeRequest]" = OrderedDict()
+        self.max_done_history = max(1, int(max_done_history))
+        # uid membership mirror of `queue`: the router's route-eviction
+        # sweep probes liveness cross-thread with GIL-atomic set/dict
+        # reads (scanning the deque from another thread can raise on
+        # concurrent mutation). A live uid is ALWAYS in at least one of
+        # _queued_uids / active / done — transitions insert into the next
+        # home before removing from the previous one.
+        self._queued_uids: set = set()
+        # the causal event bus (observability.tracing); configure_tracing
+        # mutates the singleton in place, so this cached ref stays live
+        self._ebus = get_bus()
+        self._ledger_ns = next(_LEDGER_NS)
         self._next_uid = 0
         self._closed_reason: Optional[str] = None
         self.counters: Dict[str, int] = {
@@ -67,7 +91,7 @@ class RequestManager:
     # ------------------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0, trace_id: Optional[int] = None) -> int:
         """Enqueue a request; returns its uid. Raises :class:`ShedError`
         (``reason=queue_full`` or ``draining``, both retryable) instead of
         growing the queue without bound — admission control IS the refusal."""
@@ -93,6 +117,11 @@ class RequestManager:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = self.clock()
+        bus = self._ebus
+        if trace_id == SAMPLED_OUT:
+            trace_id = None          # a minting layer upstream (frontend)
+        elif trace_id is None and bus.enabled:  # already decided: nothing
+            trace_id = bus.mint_trace()     # sampled: None = emit nothing
         req = ServeRequest(
             uid=self._next_uid, prompt=as_prompt(prompt),
             max_new_tokens=int(max_new_tokens
@@ -100,9 +129,16 @@ class RequestManager:
                                else self.default_max_new_tokens),
             priority=int(priority),
             deadline=None if deadline_s is None else now + float(deadline_s),
-            submitted_at=now)
+            submitted_at=now, trace_id=trace_id)
         self._next_uid += 1
+        self._queued_uids.add(req.uid)      # membership BEFORE visibility
         self.queue.append(req)
+        if req.trace_id is not None and bus.enabled:
+            # the request's async track opens here; every later subsystem
+            # stamps the same (cat="request", id=trace_id) track
+            bus.async_begin("request", "request", req.trace_id, args={
+                "subsys": "serving", "what": "submit", "uid": req.uid,
+                "prompt_tokens": req.prompt_len, "priority": req.priority})
         return req.uid
 
     def close(self, reason: str = "draining") -> None:
@@ -129,16 +165,24 @@ class RequestManager:
     # lifecycle transitions (called by the batcher)
     # ------------------------------------------------------------------
     def admit(self, req: ServeRequest) -> None:
-        self.queue.remove(req)
         req.state = PREFILLING
         req.admitted_at = self.clock()
-        self.active[req.uid] = req
+        self.active[req.uid] = req          # next home before leaving queue
+        self.queue.remove(req)
+        self._queued_uids.discard(req.uid)
         self.counters["admitted"] += 1
         if self.metrics is not None and self.metrics.spans_enabled:
             self.metrics.queue_wait_ms.observe(
                 (req.admitted_at - req.submitted_at) * 1e3)
+        if req.trace_id is not None and self._ebus.enabled:
+            self._ebus.async_instant("request", "request", req.trace_id,
+                                     args={"subsys": "serving",
+                                           "what": "admit", "uid": req.uid})
 
     def _finish(self, req: ServeRequest, state: str) -> None:
+        req.state = state
+        req.finished_at = self.clock()
+        self.done[req.uid] = req            # next home before leaving others
         if req.uid in self.active:
             del self.active[req.uid]
             if self.release_fn is not None:
@@ -147,9 +191,29 @@ class RequestManager:
                 self.release_fn([req.uid])
         elif req in self.queue:
             self.queue.remove(req)
-        req.state = state
-        req.finished_at = self.clock()
-        self.done[req.uid] = req
+        self._queued_uids.discard(req.uid)
+        if req.trace_id is not None and self._ebus.enabled:
+            self._ebus.async_end("request", "request", req.trace_id, args={
+                "subsys": "serving", "what": "terminal", "uid": req.uid,
+                "state": state, "finish_reason": req.finish_reason or None,
+                "generated": len(req.generated)})
+        self._evict_done()
+
+    def _evict_done(self) -> None:
+        """FIFO-evict terminal requests past ``max_done_history``. The
+        evicted span is retained in the flight recorder's last-K terminal
+        ring (when tracing is on) so ``trace()``/``resolve()`` still
+        answer for it — the post-mortem fix for spans vanishing with the
+        ledger entry."""
+        if len(self.done) <= self.max_done_history:
+            return
+        from deepspeed_tpu.observability.trace import get_flight_recorder
+
+        rec = get_flight_recorder()
+        while len(self.done) > self.max_done_history:
+            uid, req = self.done.popitem(last=False)
+            if rec is not None:
+                rec.record_terminal((self._ledger_ns, uid), req.span())
 
     def complete(self, req: ServeRequest, finish_reason: str = "length"
                  ) -> None:
@@ -218,24 +282,38 @@ class RequestManager:
     # ------------------------------------------------------------------
     def resolve(self, uid: int) -> Optional[str]:
         """Terminal/current state for any uid ever submitted, or None for an
-        unknown uid. Drills assert every admitted uid resolves terminal."""
+        unknown uid. Drills assert every admitted uid resolves terminal.
+        A uid evicted from the bounded ledger resolves through the flight
+        recorder's retained terminal spans."""
         if uid in self.done:
             return self.done[uid].state
         if uid in self.active:
             return self.active[uid].state
         if any(r.uid == uid for r in self.queue):
             return QUEUED
-        return None
+        span = self._evicted_span(uid)
+        return None if span is None else span.get("state")
+
+    def _evicted_span(self, uid: int) -> Optional[Dict]:
+        from deepspeed_tpu.observability.trace import get_flight_recorder
+
+        rec = get_flight_recorder()
+        return (None if rec is None
+                else rec.terminal_trace((self._ledger_ns, uid)))
 
     def result(self, uid: int) -> Optional[ServeRequest]:
         return self.done.get(uid) or self.active.get(uid) or next(
             (r for r in self.queue if r.uid == uid), None)
 
     def trace(self, uid: int) -> Optional[Dict]:
-        """The request's span record (queue-wait/TTFT/TPOT/e2e ms), or None
-        for an unknown uid — see :meth:`ServeRequest.span`."""
+        """The request's span record (queue-wait/TTFT/TPOT/e2e ms) — see
+        :meth:`ServeRequest.span`. Falls back to the flight recorder's
+        retained terminal spans for a uid the bounded ledger has already
+        evicted; None only for a uid this process never knew."""
         req = self.result(uid)
-        return None if req is None else req.span()
+        if req is not None:
+            return req.span()
+        return self._evicted_span(uid)
 
     @property
     def queue_depth(self) -> int:

@@ -85,6 +85,9 @@ class ServeRequest:
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
+    # causal event-bus track id (observability.tracing); None = tracing
+    # off or this request sampled out — emit nothing for it
+    trace_id: Optional[int] = None
     # terminal bookkeeping
     finish_reason: str = ""            # length | eos | shed slug | expired
     error: Optional[ShedError] = None
@@ -123,6 +126,7 @@ class ServeRequest:
         decode_ms = ms(self.first_token_at, self.last_token_at)
         return {
             "uid": self.uid, "state": self.state,
+            "trace_id": self.trace_id,
             "finish_reason": self.finish_reason or None,
             "prompt_tokens": self.prompt_len,
             "generated_tokens": len(self.generated),

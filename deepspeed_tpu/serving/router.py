@@ -40,6 +40,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
 from deepspeed_tpu.serving.batcher import DEGRADED, DRAINING, READY
 from deepspeed_tpu.serving.protocol import terminal_record
 from deepspeed_tpu.serving.request import CANCELLED, ServeRequest, ShedError
@@ -165,14 +166,18 @@ class Replica:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None, priority: int = 0,
-               events: Optional["queue.Queue"] = None) -> int:
+               events: Optional["queue.Queue"] = None,
+               trace_id: Optional[int] = None) -> int:
         """Submit through the worker; returns the batcher uid. Token/end
         events for the request are published to ``events`` (if given)
         starting before the first step that could touch it — no token is
-        ever generated unobserved."""
+        ever generated unobserved. ``trace_id`` rides through to the
+        manager so the request keeps ONE causal track across the
+        frontend/router/batcher hop (and across migrations)."""
         return self._command("submit", dict(
             prompt=prompt, max_new_tokens=max_new_tokens,
-            deadline_s=deadline_s, priority=priority, events=events))
+            deadline_s=deadline_s, priority=priority, events=events,
+            trace_id=trace_id))
 
     def cancel(self, uid: int) -> bool:
         return self._command("cancel", uid)
@@ -391,6 +396,7 @@ class ReplicaRouter:
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None, priority: int = 0,
                events: Optional["queue.Queue"] = None,
+               trace_id: Optional[int] = None,
                _exclude=(), _ruid: Optional[int] = None) -> int:
         """Route to the least-loaded replica; retry retryable sheds on
         siblings; surface the final :class:`ShedError` (with the LARGEST
@@ -407,7 +413,7 @@ class ReplicaRouter:
             try:
                 uid = rep.submit(prompt, max_new_tokens=max_new_tokens,
                                  deadline_s=deadline_s, priority=priority,
-                                 events=events)
+                                 events=events, trace_id=trace_id)
             except ShedError as e:
                 if not e.retryable:
                     raise            # oversize etc: no sibling can help
@@ -512,12 +518,25 @@ class ReplicaRouter:
                     raise ShedError("draining", retryable=True,
                                     retry_after_s=1.0,
                                     detail="migration disabled")
+                # a traced request keeps its id across the migration; an
+                # untraced one (sampled out, or submitted while tracing
+                # was off) must not get minted a fresh mid-life track
+                mig_trace = (req.trace_id if req.trace_id is not None
+                             else (SAMPLED_OUT if get_bus().enabled
+                                   else None))
                 new_ruid = self.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
                     deadline_s=remaining, priority=req.priority,
-                    events=events, _exclude=(name,),
+                    events=events, trace_id=mig_trace,
+                    _exclude=(name,),
                     _ruid=None if ruid is None else ruid)
                 migrated += 1
+                bus = get_bus()
+                if req.trace_id is not None and bus.enabled:
+                    bus.async_instant("request", "request", req.trace_id,
+                                      args={"subsys": "router",
+                                            "what": "migrated",
+                                            "from": name})
                 if events is not None:
                     # announced only once the sibling really took it (a
                     # refused migration must read as a shed, not a move);
@@ -555,10 +574,14 @@ class ReplicaRouter:
 
     def _evict_terminal_routes(self) -> None:  #: holds: _lock
         """Called under ``self._lock``. Drops oldest routes past the
-        history cap, but ONLY terminal ones — reading the replica ledger's
-        ``done`` membership is a GIL-atomic dict probe, so no cross-thread
-        handshake is needed. A live head stops the sweep (O(1) amortized;
-        overshoot bounded by the number of live requests)."""
+        history cap, but ONLY terminal ones — liveness is probed with
+        GIL-atomic dict/set reads on the replica's manager (``active`` /
+        ``_queued_uids``), so no cross-thread handshake is needed. A uid
+        in neither is terminal: in the ``done`` ledger, or already evicted
+        from it by the bounded-ledger sweep (a route must not wedge the
+        eviction queue waiting for a ledger entry that is never coming
+        back). A live head stops the sweep (O(1) amortized; overshoot
+        bounded by the number of live requests)."""
         while (len(self._routes) > self.cfg.max_route_history
                and self._route_order):
             head = self._route_order[0]
@@ -567,9 +590,15 @@ class ReplicaRouter:
                 self._route_order.popleft()
                 continue
             rep = self.replicas.get(route.replica)
-            if rep is not None \
-                    and route.uid not in rep.batcher.manager.done:
-                break                  # oldest route still live: wait
+            if rep is not None:
+                m = rep.batcher.manager
+                # probe in REVERSE transition order (queued, then active):
+                # admit() inserts into active BEFORE discarding from the
+                # queued set, so not-queued-now implies already-in-active
+                # (or terminal) — probing active first would let an admit
+                # between the two reads make a live request look terminal
+                if route.uid in m._queued_uids or route.uid in m.active:
+                    break              # oldest route still live: wait
             self._route_order.popleft()
             del self._routes[head]
             self._by_loc.pop((route.replica, route.uid), None)

@@ -551,6 +551,119 @@ class TestBaseline:
 # ---------------------------------------------------------------------------
 CLI = os.path.join(TOOLS, "dslint.py")
 
+# ---------------------------------------------------------------------------
+# event-span (ISSUE 13)
+# ---------------------------------------------------------------------------
+class TestEventSpan:
+    def test_unclosed_begin_before_fallible_work(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self):
+                    self._ebus.begin("batcher", "step")
+                    self.engine.put()            # can raise → span leaks
+                    self._ebus.end("batcher", "step")
+        """, rules=["event-span"])
+        assert rules_of(fs) == ["event-span"]
+
+    def test_raw_emit_begin_phase_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self, bus):
+                    bus.emit("B", "engine", "put")
+                    self.dispatch()              # can raise → span leaks
+                    bus.emit("E", "engine", "put")
+        """, rules=["event-span"])
+        assert rules_of(fs) == ["event-span"]
+
+    def test_guard_nested_begin_flagged(self, tmp_path):
+        # the dominant real emit idiom nests the begin under an
+        # `if tracing:` guard — the scan must follow the enclosing
+        # blocks out, not just the function's top-level statements
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self):
+                    if self.tracing:
+                        self._ebus.begin("batcher", "step")
+                        self.engine.put()    # can raise → span leaks
+                        self._ebus.end("batcher", "step")
+        """, rules=["event-span"])
+        assert rules_of(fs) == ["event-span"]
+
+    def test_guarded_begin_with_fallible_work_after_guard_flagged(
+            self, tmp_path):
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self):
+                    if self.tracing:
+                        self._ebus.begin("batcher", "step")
+                    self.engine.put()        # can raise → span leaks
+                    if self.tracing:
+                        self._ebus.end("batcher", "step")
+        """, rules=["event-span"])
+        assert rules_of(fs) == ["event-span"]
+
+    def test_guarded_trailing_begin_is_clean(self, tmp_path):
+        # begin at the END of its guard with nothing fallible after the
+        # guard either: the open-at-exit lifecycle handoff, nested
+        fs = lint(tmp_path, """
+            class Ticket:
+                def __init__(self, bus, name):
+                    self.name = name
+                    if bus.enabled:
+                        bus.async_begin("aio", "swap_op", 1)
+        """, rules=["event-span"])
+        assert fs == []
+
+    def test_try_finally_end_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self):
+                    self._ebus.begin("batcher", "step")
+                    try:
+                        self.engine.put()
+                    finally:
+                        self._ebus.end("batcher", "step")
+        """, rules=["event-span"])
+        assert fs == []
+
+    def test_span_contextmanager_is_clean(self, tmp_path):
+        # the blessed idiom: with-block IS the finally
+        fs = lint(tmp_path, """
+            class Stepper:
+                def step(self, bus):
+                    with bus.span("batcher", "step"):
+                        self.engine.put()
+        """, rules=["event-span"])
+        assert fs == []
+
+    def test_async_open_at_exit_handoff_is_clean(self, tmp_path):
+        # cross-function b/e lifecycle (submit opens, terminal closes):
+        # a trailing async_begin with nothing fallible after it is the
+        # intended idiom, not a leak
+        fs = lint(tmp_path, """
+            class Manager:
+                def submit(self, req, bus):
+                    self.queue.append(req)
+                    bus.async_begin("request", "request", req.trace_id)
+                    return req.uid
+
+                def finish(self, req, bus):
+                    bus.async_end("request", "request", req.trace_id)
+        """, rules=["event-span"])
+        assert fs == []
+
+    def test_non_bus_begin_is_ignored(self, tmp_path):
+        # txn.begin() on a database handle is not an event emit
+        fs = lint(tmp_path, """
+            class Store:
+                def write(self, txn, rows):
+                    txn.begin()
+                    self.insert(rows)
+                    txn.commit()
+        """, rules=["event-span"])
+        assert fs == []
+
+
 INJECTED_BUGS = {
     "host-sync": """
         import jax
@@ -590,6 +703,13 @@ INJECTED_BUGS = {
                     self.cancel(uid)
                 except Exception:
                     pass
+    """,
+    "event-span": """
+        class S:
+            def step(self, bus):
+                bus.begin("batcher", "step")
+                self.engine.put()
+                bus.end("batcher", "step")
     """,
 }
 

@@ -336,7 +336,7 @@ class _StallBackend:
         self._raise = cancel_raises
 
     def submit(self, prompt, *, max_new_tokens=None, deadline_s=None,
-               priority=0, events=None):
+               priority=0, events=None, trace_id=None):
         self.submitted.append(prompt)
         return 42
 
@@ -355,7 +355,7 @@ class _ChattyBackend(_StallBackend):
     writing, so a client disconnect surfaces as a broken pipe."""
 
     def submit(self, prompt, *, max_new_tokens=None, deadline_s=None,
-               priority=0, events=None):
+               priority=0, events=None, trace_id=None):
         def pump():
             i = 0
             while not self.cancelled and i < 100_000:

@@ -1,0 +1,111 @@
+"""Perf-trend ledger: append-only JSONL of bench results.
+
+Every run of ``bench.py`` / ``bench_infer.py`` / ``bench_capacity.py``
+appends one schema-versioned, git-sha-stamped line to
+``tools/bench_ledger.jsonl``, turning the round artifacts
+(``BENCH_r0*.json`` snapshots) into a machine-readable trajectory.
+``tools/bench_trend.py`` diffs the latest entry against the best prior
+one and exits nonzero past a configurable regression threshold — the
+missing half of the ROADMAP's scaling-artifact item: a *trend*, not a
+point.
+
+Ledger line shape (schema 1)::
+
+    {"schema": 1, "bench": "bench", "git_sha": "abc123...",
+     "time": 1722800000.0, "iso_time": "2026-08-04T17:00:00",
+     "metric": "train_tokens_per_sec_per_chip", "value": 24100.0,
+     "unit": "tokens/s", "result": {...the bench's full JSON...}}
+
+``append_ledger`` is deliberately best-effort and silent on failure —
+the ledger must never sink a benchmark run — and honours
+``DSTPU_BENCH_LEDGER=0`` (skip) / ``DSTPU_BENCH_LEDGER_PATH`` (redirect,
+e.g. for tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Optional
+
+LEDGER_SCHEMA = 1
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_LEDGER = os.path.join(_HERE, "bench_ledger.jsonl")
+
+
+def git_sha(repo_dir: Optional[str] = None) -> str:
+    """The current commit (short sha, '-dirty' suffixed when the tree has
+    local modifications); 'unknown' outside a git checkout."""
+    cwd = repo_dir or os.path.dirname(_HERE)
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"], cwd=cwd,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        if not sha:
+            return "unknown"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            cwd=cwd, capture_output=True, text=True, timeout=10
+        ).stdout.strip()
+        return sha + ("-dirty" if dirty else "")
+    except Exception:
+        return "unknown"
+
+
+def ledger_path() -> str:
+    return os.environ.get("DSTPU_BENCH_LEDGER_PATH", DEFAULT_LEDGER)
+
+
+def append_ledger(result: dict, bench: str,
+                  path: Optional[str] = None) -> Optional[str]:
+    """Append one bench result to the ledger; returns the path written or
+    None (disabled / failed — never raises)."""
+    if os.environ.get("DSTPU_BENCH_LEDGER", "1") == "0":
+        return None
+    try:
+        p = path or ledger_path()
+        now = time.time()
+        entry = {
+            "schema": LEDGER_SCHEMA,
+            "bench": bench,
+            "git_sha": git_sha(),
+            "time": now,
+            "iso_time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                      time.localtime(now)),
+            "metric": result.get("metric"),
+            "value": result.get("value"),
+            "unit": result.get("unit"),
+            "result": result,
+        }
+        line = json.dumps(entry, default=str)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+        return p
+    except Exception:
+        return None
+
+
+def read_ledger(path: Optional[str] = None) -> list:
+    """All parseable ledger entries, in file order (corrupt lines are
+    skipped — an interrupted append must not poison the trend)."""
+    p = path or ledger_path()
+    out = []
+    try:
+        with open(p, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(entry, dict) and entry.get("schema") == \
+                        LEDGER_SCHEMA:
+                    out.append(entry)
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Perf-trend gate: diff the latest bench ledger entry against the best
+prior one; exit nonzero past the regression threshold.
+
+Reads ``tools/bench_ledger.jsonl`` (see ``tools/bench_ledger.py`` — each
+``bench.py`` / ``bench_infer.py`` / ``bench_capacity.py`` run appends one
+schema-versioned, git-sha-stamped line). For every tracked metric of every
+bench with >= 2 entries, the LATEST value is compared against the BEST
+prior value; a drop larger than ``--threshold`` (default 15 % — the same
+inter-window spread ``bench.py`` itself tolerates) is a regression:
+
+    python tools/bench_trend.py                     # all benches
+    python tools/bench_trend.py --bench bench       # one bench
+    python tools/bench_trend.py --threshold 0.10
+
+Exit code 0 = no regression (including "not enough data yet"), 1 = at
+least one tracked metric regressed, 2 = usage/ledger error. The JSON
+verdict on stdout lists every comparison so CI logs carry the numbers,
+not just the verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+try:
+    from bench_ledger import read_ledger
+except ImportError:                      # invoked as tools/bench_trend.py
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_ledger import read_ledger
+
+#: tracked (dotted-path, direction) per bench; a ``*`` path segment fans
+#: out over dict keys and the BEST match is taken (e.g. the fastest
+#: decode occupancy) — all current metrics are higher-is-better
+TRACKED = {
+    "bench": [("value", "higher")],
+    "bench_infer": [("prefill_tokens_per_sec", "higher"),
+                    ("decode.*.tokens_per_sec", "higher")],
+    "bench_capacity": [("best.params_b", "higher")],
+}
+
+
+def extract(result: dict, path: str) -> Dict[str, float]:
+    """Dotted-path lookup into a bench result, returned as
+    ``{concrete_path: value}``. A ``*`` segment fans out over dict keys
+    into SEPARATE concrete paths — each measured config is its own trend
+    series, because two runs that measured different config sets (e.g.
+    decode occupancies 8/32 vs 32/128+quant variants) are not comparable
+    as a max: the gate would flag a phantom regression whenever the
+    richer set goes unmeasured, and mask a real one behind any still-fast
+    sibling config."""
+    nodes = [("", result)]
+    for part in path.split("."):
+        nxt = []
+        for prefix, node in nodes:
+            if not isinstance(node, dict):
+                continue
+            if part == "*":
+                nxt.extend((f"{prefix}.{k}" if prefix else str(k), v)
+                           for k, v in node.items())
+            elif part in node:
+                nxt.append((f"{prefix}.{part}" if prefix else part,
+                            node[part]))
+        nodes = nxt
+    return {p: float(v) for p, v in nodes
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def compare(entries: List[dict], threshold: float,
+            bench: Optional[str] = None) -> dict:
+    """The trend verdict over parsed ledger entries (pure function — the
+    tier-1 tests drive it with synthetic ledgers). A concrete metric is
+    gated only when the bench's LATEST run measured it — a config the
+    newest run skipped is "no data", not a regression."""
+    comparisons, regressions = [], []
+    benches = sorted({e["bench"] for e in entries
+                      if bench is None or e["bench"] == bench})
+    for b in benches:
+        rows = [e for e in entries if e["bench"] == b]
+        if len(rows) < 2:
+            continue
+        per_row = [(e, {}) for e in rows]
+        for path, _direction in TRACKED.get(b, [("value", "higher")]):
+            for e, vals in per_row:
+                vals.update(extract(e.get("result") or {}, path))
+        latest_e, latest_vals = per_row[-1]
+        metrics = sorted(latest_vals)
+        for metric in metrics:
+            prior = [(e, vals[metric]) for e, vals in per_row[:-1]
+                     if metric in vals]
+            if not prior:
+                continue
+            latest = latest_vals[metric]
+            best_e, best = max(prior, key=lambda ev: ev[1])
+            drop = (best - latest) / best if best > 0 else 0.0
+            rec = {
+                "bench": b, "metric": metric,
+                "latest": latest, "latest_sha": latest_e.get("git_sha"),
+                "best_prior": best, "best_sha": best_e.get("git_sha"),
+                "change_frac": round(-drop, 4),
+                "regressed": drop > threshold,
+            }
+            comparisons.append(rec)
+            if rec["regressed"]:
+                regressions.append(rec)
+    return {"threshold": threshold, "entries": len(entries),
+            "comparisons": comparisons, "regressions": regressions,
+            "ok": not regressions}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default tools/bench_ledger.jsonl)")
+    ap.add_argument("--bench", default=None, help="restrict to one bench")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max tolerated fractional drop vs best prior")
+    args = ap.parse_args(argv)
+    if not (0.0 <= args.threshold < 1.0):
+        print("bench_trend: --threshold must be in [0, 1)", file=sys.stderr)
+        return 2
+    entries = read_ledger(args.ledger)
+    verdict = compare(entries, args.threshold, bench=args.bench)
+    print(json.dumps(verdict, indent=2))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """Checker registry: rule name -> checker factory."""
 
 from .control_flow import ControlFlowChecker
+from .event_span import EventSpanChecker
 from .host_sync import HostSyncChecker
 from .lifecycle import ResourceLifecycleChecker
 from .locks import LockDisciplineChecker
@@ -12,6 +13,7 @@ ALL_CHECKERS = {
     "resource-lifecycle": ResourceLifecycleChecker,
     "recompile-hazard": RecompileHazardChecker,
     "control-flow": ControlFlowChecker,
+    "event-span": EventSpanChecker,
 }
 
 RULE_HELP = {
@@ -29,6 +31,10 @@ RULE_HELP = {
     "control-flow": ("unconditional self-recursion with identical "
                      "arguments; bare/BaseException handlers swallowing "
                      "interrupts inside worker loops"),
+    "event-span": ("bus begin()/async_begin()/emit('B'|'b') sites where "
+                   "fallible work follows with no try/finally, "
+                   "bus.span(...) with-block, or pre-risk end — an "
+                   "exception exports an unclosed span"),
 }
 
 __all__ = ["ALL_CHECKERS", "RULE_HELP"]

@@ -1,0 +1,188 @@
+"""event-span lifecycle checker.
+
+The tracing-era sibling of the resource-lifecycle rule (PR 11): a
+``begin()``-style event emit opens a duration (``B``) or async (``b``)
+track on the bus, and the matching ``end()`` must land on EVERY exit
+path, or the exported trace carries an unclosed span that the exporter
+has to close synthetically — the timeline then shows a phantom
+operation running until the export horizon, which is exactly the
+misleading artifact an operator debugging a hang cannot afford. The
+fix is ``bus.span(...)`` (a context manager whose ``with`` block IS the
+``finally``) or an explicit ``try``/``finally`` around the fallible work.
+
+A call is *begin-like* when it is:
+
+* ``<recv>.begin(...)`` or ``<recv>.async_begin(...)`` where the receiver
+  spelling names a bus (contains ``bus``, e.g. ``self._ebus``, ``bus``,
+  ``get_bus()``); or
+* ``<recv>.emit("B" | "b", ...)`` on such a receiver (the raw phase API).
+
+The site is clean when any of these hold (the resource-lifecycle shapes):
+
+* it is the context expression of a ``with`` (a span-like manager);
+* it is lexically inside a ``try`` whose ``finally``/``except`` bodies
+  contain an *end-like* call (``end``/``async_end``/``emit("E"|"e")``);
+* the begin's function emits the end (or returns / hands off) before any
+  statement that can raise — trailing emits (open-at-exit lifecycle
+  handoffs, e.g. a ticket constructor opening the track its ``release``
+  closes) are clean by construction.
+
+Cross-function begin/end pairs (submit opens, terminal closes) are the
+*intended* async idiom and are not flagged — the rule fires only when
+fallible work follows the begin in the SAME function unprotected.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, SourceFile, dotted_name
+
+RULE = "event-span"
+
+BEGIN_METHODS = {"begin", "async_begin"}
+END_METHODS = {"end", "async_end"}
+BUS_HINT = "bus"
+BEGIN_PHASES = {"B", "b"}
+END_PHASES = {"E", "e"}
+
+
+def _recv_is_bus(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = call.func.value
+    name = dotted_name(recv).lower()
+    if BUS_HINT in name:
+        return True
+    # get_bus().begin(...) — the receiver is a call, not a name chain
+    if isinstance(recv, ast.Call):
+        return BUS_HINT in dotted_name(recv.func).lower()
+    return False
+
+
+def _emit_phase(call: ast.Call) -> str:
+    """The literal phase of an ``emit("X", ...)`` call, or ""."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return ""
+
+
+def _is_begin(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute) or not _recv_is_bus(call):
+        return False
+    meth = call.func.attr
+    if meth in BEGIN_METHODS:
+        return True
+    return meth == "emit" and _emit_phase(call) in BEGIN_PHASES
+
+
+def _is_end_call(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    meth = node.func.attr
+    if meth in END_METHODS:
+        return True
+    return meth == "emit" and _emit_phase(node) in END_PHASES
+
+
+def _contains_end(nodes: Iterable[ast.stmt]) -> bool:
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and _is_end_call(node):
+                return True
+    return False
+
+
+class EventSpanChecker:
+    rule = RULE
+
+    def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_begin(node)):
+                continue
+            meth = node.func.attr  # type: ignore[union-attr]
+            parent = sf.parents.get(node)
+            if isinstance(parent, (ast.withitem, ast.Return)):
+                continue
+            # protected by an enclosing try whose finally/except ends it
+            protected = False
+            for anc in sf.iter_parents(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+                if isinstance(anc, ast.Try):
+                    cleanup: List[ast.stmt] = list(anc.finalbody)
+                    for h in anc.handlers:
+                        cleanup.extend(h.body)
+                    if _contains_end(cleanup):
+                        protected = True
+                        break
+            if protected:
+                continue
+            # walk the statements that EXECUTE after the begin: the rest
+            # of its enclosing block, then — when that block exhausts
+            # undecided — the statements after the enclosing compound
+            # statement, out to the function boundary (a begin nested in
+            # `if self.tracing:` leaks just the same when fallible work
+            # follows the guard). The first decisive statement wins:
+            # Return = open-at-exit handoff (the async submit→terminal
+            # idiom), a Try decides by whether its finally/except ends
+            # the span, an end-call is clean, any other call is the leak.
+            stmt = node
+            while stmt is not None and not isinstance(stmt, ast.stmt):
+                stmt = sf.parents.get(stmt)
+            risky = False
+            decided = False
+            while stmt is not None and not decided:
+                owner = sf.parents.get(stmt)
+                if owner is None:
+                    break
+                block = None
+                for _field, val in ast.iter_fields(owner):
+                    if isinstance(val, list) and stmt in val:
+                        block = val
+                        break
+                if block is not None:
+                    for s in block[block.index(stmt) + 1:]:
+                        if isinstance(s, ast.Return):
+                            decided = True
+                            break
+                        if isinstance(s, ast.Try):
+                            cleanup = list(s.finalbody)
+                            for h in s.handlers:
+                                cleanup.extend(h.body)
+                            decided = True
+                            risky = not _contains_end(cleanup)
+                            break
+                        if _contains_end([s]):
+                            decided = True
+                            break
+                        if any(isinstance(sub, ast.Call)
+                               for sub in ast.walk(s)):
+                            decided = True
+                            risky = True
+                            break
+                if decided:
+                    break
+                nxt = owner
+                while nxt is not None and not isinstance(nxt, ast.stmt):
+                    nxt = sf.parents.get(nxt)
+                if nxt is None or isinstance(nxt, (ast.FunctionDef,
+                                                   ast.AsyncFunctionDef)):
+                    break              # function end: open-at-exit handoff
+                stmt = nxt
+            if not risky:
+                continue
+            out.append(sf.finding(
+                self.rule, node,
+                f"'{meth}' opens an event span but fallible work follows "
+                f"with no try/finally (or with bus.span(...)) closing it "
+                f"on the exception path — an exception here exports an "
+                f"unclosed span"))
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        return []

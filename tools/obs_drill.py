@@ -346,10 +346,93 @@ def scenario_overhead_budget(workdir):
     return ok_ops and ok_e2e, details
 
 
+def scenario_tracing_overhead(workdir):
+    """The event-bus budget proof, same shape as part 1 of
+    overhead-budget: microbenchmark EXACTLY the bus operations one traced
+    serving step performs (a step span B/E pair, the engine put span, two
+    async request stamps, four call-site enabled-guards) against a
+    measured median step time — must stay under 2 % (or the 50 µs
+    timer-noise floor). Disabled cost is measured separately and must be
+    ~0 (an attribute check + branch: < 1 µs for ALL of a step's guards),
+    and the ring must hold its bound under a 10k-event storm."""
+    from deepspeed_tpu.observability import (MetricsRegistry,
+                                             configure_tracing, get_bus)
+
+    # measured median step on this box (same loaded-batcher shape as the
+    # overhead-budget scenario) — the denominator of the 2% budget
+    b = _make_batcher(_make_engine(), MetricsRegistry(),
+                      default_max_new_tokens=100)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    [b.submit(rng.integers(0, 250, 24)) for _ in range(4)]
+    while b.manager.prefilling():
+        b.step()
+    samples = []
+    for _ in range(24):
+        t0 = time.perf_counter()
+        b.step()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    step_ms = statistics.median(samples)
+    b.begin_drain("tracing overhead drill")
+    b.drain(timeout_s=30.0)
+
+    bus = get_bus()
+    configure_tracing(enabled=True, ring_size=4096, sample=1,
+                      dump_dir=workdir)
+    N = 20000
+    t0 = time.perf_counter()
+    for i in range(N):
+        # one traced serving step's bus work: the step span, the engine
+        # put span nested inside it, one admit + one first-token stamp
+        with bus.span("batcher", "step", args={"step": i}):
+            with bus.span("engine", "put", args={"uids": [0, 1, 2, 3]}):
+                pass
+            bus.async_instant("request", "request", i,
+                              args={"subsys": "serving", "what": "admit"})
+            bus.async_instant("request", "request", i,
+                              args={"subsys": "batcher",
+                                    "what": "first_token"})
+    enabled_ms = (time.perf_counter() - t0) / N * 1e3
+    # ring boundedness under a 10k-event storm (satellite invariant)
+    bus.clear()
+    for i in range(10000):
+        bus.instant("storm", "evt", args={"i": i})
+    storm_len = len(bus._rings["storm"])
+    configure_tracing(enabled=False)
+    bus.clear()
+    t0 = time.perf_counter()
+    for i in range(N):
+        if bus.enabled:            # the per-site guard, 4x per step
+            raise AssertionError
+        if bus.enabled:
+            raise AssertionError
+        if bus.enabled:
+            raise AssertionError
+        if bus.enabled:
+            raise AssertionError
+    disabled_ms = (time.perf_counter() - t0) / N * 1e3
+    disabled_events = bus.total_events()
+
+    budget_ms = 0.02 * step_ms
+    ok_enabled = enabled_ms <= max(budget_ms, 0.05)
+    ok_disabled = disabled_ms <= 0.001 and disabled_events == 0
+    details = {"ms_per_step": round(step_ms, 4),
+               "budget_ms": round(budget_ms, 4),
+               "enabled_cost_ms_per_step": round(enabled_ms, 5),
+               "enabled_cost_pct": round(enabled_ms / step_ms * 100, 3),
+               "disabled_cost_ms_per_step": round(disabled_ms, 6),
+               "disabled_events": disabled_events,
+               "storm_ring_len": storm_len, "ring_size": 4096,
+               "ok_enabled": ok_enabled, "ok_disabled": ok_disabled}
+    return ok_enabled and ok_disabled and storm_len == 4096, details
+
+
 SCENARIOS = {
     "metrics-under-load": scenario_metrics_under_load,
     "profile-capture": scenario_profile_capture,
     "overhead-budget": scenario_overhead_budget,
+    "tracing-overhead": scenario_tracing_overhead,
 }
 
 

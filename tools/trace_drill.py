@@ -1,0 +1,377 @@
+#!/usr/bin/env python
+"""Tracing drill CLI: prove the causal event bus, the Chrome-trace
+export, and the crash flight recorder against real loads — exit nonzero
+if any invariant fails (the tracing face of ``tools/obs_drill.py``).
+
+Scenarios:
+
+* **storm-trace** — a real-socket HTTP storm against a frontend + replica
+  with the prefix-cache KV tier enabled and a pool small enough to force
+  demote→promote cycles. Invariants: ``GET /v1/trace`` returns JSON that
+  passes the trace-event grammar (every B matched by an E on its tid,
+  async ids balanced); every submitted request resolves terminal; at
+  least one request's causal chain spans the frontend → serving →
+  batcher → engine subsystems; and the warmed shared-prefix request's
+  chain reaches the KV tier (a ``promote_attach`` for its uid — the
+  "frontend admit → batcher step → engine put → KV-tier op" acceptance
+  chain).
+* **abort-dump** — an injected NaN burst exhausts the StepGuard budget on
+  a tiny training engine with tracing configured. Invariants: EXACTLY one
+  flight-recorder dump lands in the dump dir; it embeds a grammar-valid
+  trace containing the aborting step's ``resilience`` events
+  (``bad_step`` leading up to ``stepguard_abort``).
+* **disabled-no-events** — tracing NOT configured: the same serving load
+  records zero events, ``trace_export`` is empty, and abort paths write
+  no dumps (the "~0 when disabled" contract, behaviorally).
+
+    python tools/trace_drill.py --list
+    python tools/trace_drill.py --scenario storm-trace
+    python tools/trace_drill.py --all
+
+Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
+Slow pytest wrappers live in ``tests/unit/test_tracing.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _reset_tracing():
+    from deepspeed_tpu.observability import configure_tracing, get_bus
+
+    configure_tracing(enabled=False)
+    get_bus().clear()
+
+
+def _make_serving(trace: bool, workdir: str):
+    """Frontend + replica over a tier-enabled engine with a small pool."""
+    from deepspeed_tpu.config.config import FrontendConfig, ServingConfig
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.observability import MetricsRegistry, configure_tracing
+    from deepspeed_tpu.serving import ContinuousBatcher
+    from deepspeed_tpu.serving.frontend import ServingFrontend
+    from deepspeed_tpu.serving.router import Replica
+
+    if trace:
+        configure_tracing(enabled=True, ring_size=8192, sample=1,
+                          dump_dir=os.path.join(workdir, "flight"),
+                          retain_terminal=64)
+    eng = InferenceEngineV2(
+        TransformerLM(get_preset("tiny")), max_sequences=4, max_seq_len=128,
+        block_size=16, num_blocks=24,
+        prefix_cache={"enabled": True,
+                      "tiers": {"enabled": True, "host_mb": 0.25}})
+    b = ContinuousBatcher(eng, ServingConfig(
+        prefill_chunk=64, default_max_new_tokens=4), registry=MetricsRegistry())
+    rep = Replica("solo", b).start()
+    fe = ServingFrontend(rep, FrontendConfig(), registry=b.metrics.registry)
+    fe.start()
+    return eng, b, rep, fe
+
+
+def _post(host, port, prompt, timeout=120):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps({"prompt": [int(t) for t in prompt],
+                                      "max_new_tokens": 4}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def _get_json(host, port, path, timeout=60):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# scenarios: each returns (ok: bool, details: dict)
+# ---------------------------------------------------------------------------
+
+def scenario_storm_trace(workdir):
+    """HTTP storm with tracing on: grammar-valid /v1/trace, every request
+    terminal, >=1 causal chain spanning frontend/serving/batcher/engine,
+    and the warm shared-prefix request's chain reaching the KV tier."""
+    from deepspeed_tpu.observability import get_bus, validate_trace
+
+    _reset_tracing()
+    eng, b, rep, fe = _make_serving(trace=True, workdir=workdir)
+    shared = list(range(1, 49))                   # 3 full blocks + tail
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(prompt):
+        st, body = _post(fe.server.host, fe.server.port, prompt)
+        with lock:
+            outcomes.append((st, body.get("state"), body.get("id")))
+
+    try:
+        # phase 1: seed the shared prefix (published on completion)
+        client(shared)
+        # phase 2: distinct-prefix churn forces the seed's blocks out of
+        # HBM into the host tier (4 concurrent clients x 2 rounds)
+        for round_ in range(2):
+            threads = [threading.Thread(
+                target=client,
+                args=([1000 + 100 * round_ + 10 * i + j
+                       for j in range(48)],))
+                for i in range(4)]
+            [t.start() for t in threads]
+            [t.join(timeout=120) for t in threads]
+        demotions = eng._tier_store.counters["host_demotions"]
+        # phase 3: the warm request — its prefix now lives in the tier,
+        # so the match promotes (the KV-tier link of the causal chain)
+        st, warm = _post(fe.server.host, fe.server.port, shared[:-1] + [7])
+        warm_uid = warm.get("id")
+        # export over the wire: the /v1/trace mount is the product surface
+        code, doc = _get_json(fe.server.host, fe.server.port, "/v1/trace")
+        errors = validate_trace(doc)
+    finally:
+        fe.close()
+        rep.close()
+        eng.close()
+
+    bus = get_bus()
+    events = bus.events()
+    # per-trace subsystem chains: request-track args.subsys + engine spans
+    # joined by uid + kv_tier promote_attach joined by uid
+    by_trace = {}
+    uid_of = {}
+    for e in events:
+        if e.cat == "request" and e.args and "subsys" in e.args:
+            s = by_trace.setdefault(e.trace_id, set())
+            s.add(e.args["subsys"])
+            if "uid" in e.args:
+                uid_of[e.trace_id] = e.args["uid"]
+    eng_uids = set()
+    for e in events:
+        if e.cat == "engine" and e.ph == "B" and e.args:
+            eng_uids.update(e.args.get("uids", ()))
+    promo_uids = {e.args["uid"] for e in events
+                  if e.cat == "kv_tier" and e.name == "promote_attach"
+                  and e.args}
+    chains = {}
+    for tid, subsys in by_trace.items():
+        uid = uid_of.get(tid)
+        if uid in eng_uids:
+            subsys.add("engine")
+        if uid in promo_uids:
+            subsys.add("kv_tier")
+        chains[tid] = sorted(subsys)
+    core = {"frontend", "serving", "batcher", "engine"}
+    full_chains = [c for c in chains.values() if core.issubset(set(c))]
+    warm_chain = next((set(c) for t, c in chains.items()
+                       if uid_of.get(t) == warm_uid), set())
+    _reset_tracing()
+
+    details = {
+        "requests": len(outcomes) + 1,
+        "outcomes": sorted({(st, state) for st, state, _ in outcomes}),
+        "warm": {"status": st, "state": warm.get("state"),
+                 "chain": sorted(warm_chain)},
+        "trace_http_code": code,
+        "trace_events": len(doc.get("traceEvents", ())),
+        "grammar_errors": errors[:5],
+        "host_demotions_after_churn": demotions,
+        "chains_with_core4": len(full_chains),
+        "example_chain": full_chains[0] if full_chains else None,
+        "categories": sorted({e.cat for e in events}),
+    }
+    ok = (code == 200 and not errors
+          and doc.get("traceEvents")
+          and all(st == 200 and state == "completed"
+                  for st, state, _ in outcomes)
+          and warm.get("state") == "completed"
+          and demotions > 0
+          and len(full_chains) >= 1
+          and core.issubset(warm_chain)
+          and "kv_tier" in warm_chain)
+    return ok, details
+
+
+def scenario_abort_dump(workdir):
+    """Injected NaN burst exhausts the StepGuard budget: exactly ONE
+    flight dump, embedding a grammar-valid trace that carries the
+    aborting step's resilience events."""
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.observability import validate_trace
+    from deepspeed_tpu.resilience import set_injector
+    from deepspeed_tpu.resilience.guard import TooManyBadSteps
+
+    _reset_tracing()
+    set_injector(None)
+    dump_dir = os.path.join(workdir, "flight_abort")
+    eng, *_ = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 100,
+                "observability": {"tracing": {"enabled": True,
+                                              "ring_size": 2048,
+                                              "dump_dir": dump_dir}},
+                "resilience": {"enabled": True,
+                               "max_consecutive_bad_steps": 3,
+                               "faults": [{"kind": "nan_grads", "step": 2,
+                                           "times": 10}]}})
+    rng = np.random.default_rng(0)
+    # batch sized for the ambient mesh (tier-1 runs with 8 forced host
+    # devices; standalone the world is 1)
+    B = eng.train_micro_batch_size_per_gpu() * eng.topology.dp_world_size
+    aborted = False
+    abort_step = None
+    try:
+        for _ in range(20):
+            loss = eng.forward({"input_ids": rng.integers(0, 256, (B, 16))})
+            eng.backward(loss)
+            eng.step()
+    except TooManyBadSteps:
+        aborted = True
+        abort_step = int(eng.global_steps)
+    finally:
+        set_injector(None)
+        eng.shutdown()
+
+    dumps = sorted(f for f in (os.listdir(dump_dir)
+                               if os.path.isdir(dump_dir) else [])
+                   if f.startswith("flight_") and f.endswith(".json"))
+    dump_doc, res_events, grammar_errors = None, [], ["no dump"]
+    if dumps:
+        with open(os.path.join(dump_dir, dumps[0])) as f:
+            dump_doc = json.load(f)
+        grammar_errors = validate_trace(dump_doc.get("trace", {}))
+        res_events = [e for e in dump_doc["trace"]["traceEvents"]
+                      if e.get("cat") == "resilience"]
+    _reset_tracing()
+    bad = [e for e in res_events if e.get("name") == "bad_step"]
+    abort_evs = [e for e in res_events
+                 if e.get("name") == "stepguard_abort"]
+    details = {
+        "aborted": aborted, "abort_step": abort_step,
+        "dumps": dumps, "n_dumps": len(dumps),
+        "reason": dump_doc.get("reason") if dump_doc else None,
+        "grammar_errors": grammar_errors[:5],
+        "bad_step_events": len(bad),
+        "abort_events": [e.get("args") for e in abort_evs],
+    }
+    ok = (aborted and len(dumps) == 1
+          and dump_doc is not None
+          and dump_doc.get("reason") == "stepguard_abort"
+          and not grammar_errors
+          and len(bad) >= 3                      # the burnt budget
+          and len(abort_evs) == 1
+          and abort_evs[0].get("args", {}).get("step") == abort_step)
+    return ok, details
+
+
+def scenario_disabled_no_events(workdir):
+    """Tracing NOT configured: the same serving load records nothing,
+    the export is empty, and no flight dump is ever written."""
+    from deepspeed_tpu.observability import (flight_dump, get_bus,
+                                             get_flight_recorder,
+                                             trace_export)
+
+    _reset_tracing()
+    eng, b, rep, fe = _make_serving(trace=False, workdir=workdir)
+    try:
+        st, body = _post(fe.server.host, fe.server.port, list(range(1, 33)))
+        code, doc = _get_json(fe.server.host, fe.server.port, "/v1/trace")
+    finally:
+        fe.close()
+        rep.close()
+        eng.close()
+    dump = flight_dump("should_not_write")
+    details = {
+        "request": (st, body.get("state")),
+        "bus_events": get_bus().total_events(),
+        "trace_http_code": code,
+        "exported_events": len(doc.get("traceEvents", ())),
+        "recorder": get_flight_recorder() is not None,
+        "dump_path": dump,
+        "enabled_flag": doc.get("otherData", {}).get("enabled"),
+    }
+    ok = (st == 200 and body.get("state") == "completed"
+          and get_bus().total_events() == 0
+          and code == 200 and details["exported_events"] == 0
+          and details["enabled_flag"] is False
+          and dump is None and get_flight_recorder() is None
+          and not trace_export()["traceEvents"])
+    return ok, details
+
+
+SCENARIOS = {
+    "storm-trace": scenario_storm_trace,
+    "abort-dump": scenario_abort_dump,
+    "disabled-no-events": scenario_disabled_no_events,
+}
+
+
+def run_scenario(name: str, workdir=None) -> dict:
+    """Run one drill; returns the verdict record (also usable from tests)."""
+    if name not in SCENARIOS:
+        raise SystemExit(f"unknown scenario {name!r} "
+                         f"(have: {sorted(SCENARIOS)})")
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix=f"trace_{name.replace('-', '_')}_")
+    t0 = time.time()
+    try:
+        ok, details = SCENARIOS[name](workdir)
+    finally:
+        _reset_tracing()
+    return {"scenario": name, "ok": ok,
+            "seconds": round(time.time() - t0, 2),
+            "workdir": workdir, "details": details}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", help="which drill to run")
+    ap.add_argument("--all", action="store_true", help="run every scenario")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, fn in SCENARIOS.items():
+            print(f"{name}: {fn.__doc__.splitlines()[0]}")
+        return 0
+    names = list(SCENARIOS) if args.all else (
+        [args.scenario] if args.scenario else None)
+    if not names:
+        ap.error("pass --scenario NAME, --all, or --list")
+    rc = 0
+    for name in names:
+        verdict = run_scenario(name, workdir=args.workdir)
+        print(json.dumps(verdict, indent=2, default=str))
+        if not verdict["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
