@@ -228,9 +228,16 @@ def barrier() -> None:
 # In-trace collectives (use inside shard_map with a bound mesh axis name)
 # ---------------------------------------------------------------------------
 
-def _log(op: str, x) -> None:
+def _log(op: str, x, nbytes: Optional[int] = None) -> None:
+    """Record one collective's wire payload with the comms logger at trace
+    time. ``nbytes`` overrides the dense ``size * itemsize`` accounting —
+    the quantized collectives (``comm/quantized.py``) pass their actual
+    packed payload + scale bytes so ``comm/<op>_bytes`` measures the
+    compression for real."""
     try:
-        comms_logger.append(op, x.size * x.dtype.itemsize)
+        comms_logger.append(
+            op, int(nbytes) if nbytes is not None
+            else x.size * x.dtype.itemsize)
     except Exception:
         pass
 

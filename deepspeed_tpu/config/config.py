@@ -188,6 +188,67 @@ class ZenFlowConfig(DSTpuConfigModel):
         return int(self.select_interval)
 
 
+class ZeroPPConfig(DSTpuConfigModel):
+    """``zero_optimization.zero_pp`` — ZeRO++ quantized collectives
+    (Wang et al., 2023; reference ``deepspeed/runtime/zero/config.py``
+    ``zero_quantized_weights``/``zero_quantized_gradients``/
+    ``zero_hpz_partition_size``, here one validated block with the
+    features independently toggleable).
+
+    ``enabled`` turns on the explicit-collective training region
+    (``parallel/zeropp.py``): the param all-gathers and grad
+    reduce-scatters XLA would insert become explicit ``comm`` calls —
+    with every feature off this is the *bf16-collective baseline* the
+    quantized modes are measured against (fp32 master path, logged
+    ``comm/<op>_bytes``). The features then compress individual ops:
+
+    * ``qwz`` — blockwise int8/int4 quantized weight all-gather
+      (``weight_bits``); payload shrinks 2x / 4x vs bf16.
+    * ``hpz`` — a bf16 *secondary* parameter shard local to the ICI
+      slice: per-step gathers stay on fast links, the cross-slice gather
+      happens once per optimizer step at the secondary refresh.
+    * ``qgz`` — quantized gradient reduce-scatter (``grad_bits``). On a
+      sliced mesh this is TWO-hop: intra-slice reduce in bf16/fp32 over
+      ICI, inter-slice quantized over DCN — quantization error never
+      accumulates across the fast axis.
+
+    ``cross_slice_only`` restricts quantization to collectives that
+    actually cross the slice boundary (DCN); intra-slice hops stay
+    full-precision. On a single-slice mesh that means nothing is
+    quantized — a graceful no-op, not an error.
+    """
+
+    enabled: bool = False
+    qwz: bool = False            # quantized weight all-gather
+    hpz: bool = False            # slice-local secondary param shard
+    qgz: bool = False            # quantized gradient reduce-scatter
+    weight_bits: int = 8         # 4 | 8 (qwZ payload)
+    grad_bits: int = 8           # 4 | 8 (qgZ payload)
+    block_size: int = 2048       # blockwise-quant group size (elements)
+    # hpZ secondary-partition width. 0 = slice-local (the ICI extent of
+    # the fsdp axis); explicit k must divide the fsdp axis size.
+    hpz_partition_size: int = 0
+    # devices per slice along the fsdp axis for the qgZ two-hop split.
+    # 0 = derive from the mesh (ICI extent); override in tests/drills to
+    # simulate a multi-slice topology on flat hardware.
+    slice_size: int = 0
+    cross_slice_only: bool = False
+
+    @model_validator(mode="after")
+    def _check(self):
+        for name, bits in (("weight_bits", self.weight_bits),
+                           ("grad_bits", self.grad_bits)):
+            if bits not in (4, 8):
+                raise ValueError(
+                    f"zero_pp.{name} must be 4 or 8, got {bits}")
+        if self.block_size < 1:
+            raise ValueError("zero_pp.block_size must be >= 1")
+        if self.hpz_partition_size < 0 or self.slice_size < 0:
+            raise ValueError("zero_pp.hpz_partition_size / slice_size "
+                             "must be >= 0 (0 = derive from the mesh)")
+        return self
+
+
 class ZeroConfig(DSTpuConfigModel):
     """``zero_optimization`` section (reference: ``deepspeed/runtime/zero/config.py:90``).
 
@@ -217,7 +278,10 @@ class ZeroConfig(DSTpuConfigModel):
     model_persistence_threshold: int = 9999999999
     max_live_parameters: int = 1_000_000_000
     prefetch_bucket_size: int = 50_000_000
-    # ZeRO++ knobs
+    # ZeRO++: the validated block (preferred spelling)...
+    zero_pp: Optional[ZeroPPConfig] = None
+    # ...and the reference's flat knobs (kept for config parity; folded
+    # into zero_pp by the validator below — setting both is an error)
     zero_quantized_weights: bool = False       # qwZ: quantized weight all-gather
     zero_quantized_gradients: bool = False     # qgZ: quantized grad reduce
     zero_hpz_partition_size: int = 1           # hpZ: secondary (slice-local) param shard
@@ -233,6 +297,26 @@ class ZeroConfig(DSTpuConfigModel):
     def _check_stage(self):
         if not 0 <= int(self.stage) <= 3:
             raise ValueError(f"zero stage must be 0..3, got {self.stage}")
+        legacy = (self.zero_quantized_weights or self.zero_quantized_gradients
+                  or self.zero_hpz_partition_size > 1)
+        folded = ZeroPPConfig(
+            enabled=legacy,
+            qwz=self.zero_quantized_weights,
+            qgz=self.zero_quantized_gradients,
+            hpz=self.zero_hpz_partition_size > 1,
+            hpz_partition_size=self.zero_hpz_partition_size
+            if self.zero_hpz_partition_size > 1 else 0)
+        if self.zero_pp is None:
+            # materialize the block so consumers read ONE spelling; the
+            # legacy flat knobs become its feature toggles
+            self.zero_pp = folded
+        elif legacy and self.zero_pp != folded:
+            # equality tolerates pydantic re-validating an already-folded
+            # model (nested models revalidate on parent construction)
+            raise ValueError(
+                "zero_optimization sets both zero_pp and the flat ZeRO++ "
+                "knobs (zero_quantized_weights / zero_quantized_gradients "
+                "/ zero_hpz_partition_size); configure one spelling")
         return self
 
 
@@ -655,6 +739,11 @@ class KVTierConfig(DSTpuConfigModel):
     # lazily at the fence so one giant warm prefix cannot monopolize the
     # AIO threadpool mid-step
     promote_depth: int = 4
+    # NVMe tier bounds. Without them disk usage is limited only by
+    # discard-on-drop: under distinct-prefix churn the tier grows without
+    # bound. 0 = unbounded (the pre-cap behavior).
+    nvme_max_mb: float = 0.0     # LRU-drop oldest entries past this budget
+    nvme_ttl_s: float = 0.0      # drop entries idle (untouched) this long
 
     @model_validator(mode="after")
     def _check(self):
@@ -664,6 +753,10 @@ class KVTierConfig(DSTpuConfigModel):
         if self.promote_depth < 1:
             raise ValueError(
                 "inference.prefix_cache.tiers.promote_depth must be >= 1")
+        if self.nvme_max_mb < 0 or self.nvme_ttl_s < 0:
+            raise ValueError(
+                "inference.prefix_cache.tiers.nvme_max_mb / nvme_ttl_s "
+                "must be >= 0 (0 = unbounded)")
         return self
 
 

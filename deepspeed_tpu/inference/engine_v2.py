@@ -256,6 +256,8 @@ class InferenceEngineV2:
                 self._tier_store = KVTierStore(
                     host_mb=tiers.host_mb, nvme_path=tiers.nvme_path,
                     promote_depth=tiers.promote_depth,
+                    nvme_max_mb=tiers.nvme_max_mb,
+                    nvme_ttl_s=tiers.nvme_ttl_s,
                     instruments=tier_inst)
                 self.prefix_cache.attach_tier_store(self._tier_store,
                                                     self._extract_blocks)

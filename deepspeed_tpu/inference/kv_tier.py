@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,7 +47,7 @@ class _Entry:
     """One demoted block: a concatenated byte payload plus part metadata."""
 
     __slots__ = ("key", "name", "nbytes", "parts", "buf", "wticket",
-                 "loans", "dropped")
+                 "loans", "dropped", "touch")
 
     def __init__(self, key: int, nbytes: int,
                  parts: List[Tuple[str, tuple, np.dtype, int, int]]):
@@ -59,6 +59,69 @@ class _Entry:
         self.wticket = None         # in-flight NVMe write ticket
         self.loans = 0              # outstanding KVFetch views; pins the
         self.dropped = False        # entry against spill/discard
+        self.touch = 0.0            # last put/hit stamp (the TTL clock)
+
+
+class _BatchRead:
+    """ONE combined NVMe ticket serving a whole promote chain's reads.
+
+    Refcounted: ``begin_chain`` holds the base reference, every
+    :class:`KVFetch` riding the batch holds one more; the ticket's pooled
+    buffer returns when the last holder derefs. ``ticket is None`` =
+    lazily submitted at the first ``view()`` (promote-depth backpressure,
+    same contract as a lazy single fetch)."""
+
+    __slots__ = ("store", "names", "entries", "segments", "ticket", "refs",
+                 "failed", "claimed")
+
+    def __init__(self, store: "KVTierStore", names: List[str],
+                 entries: List[_Entry], ticket=None, segments=None):
+        self.store = store
+        self.names = names
+        self.entries = entries      # pinned until end_chain
+        self.segments = segments    # {entry name: (offset, nbytes)}
+        self.ticket = ticket
+        self.refs = 1               # begin_chain's base reference
+        self.failed = False
+        # names a KVFetch actually rides; a LAZY batch submits only these
+        # at fence time — unridden chain members were unpinned at
+        # end_chain and may have been cap/TTL-evicted since (their _meta
+        # is gone; reading them would poison the whole batch), and their
+        # payloads are not needed anyway (the promote chain truncated)
+        self.claimed: List[str] = []
+
+    def view(self) -> np.ndarray:
+        """The flat uint8 payload view (submits the lazy batch first)."""
+        if self.failed:
+            raise IOError("batched promote read already failed")
+        if self.ticket is None:
+            self.ticket, self.segments = \
+                self.store._submit_read_many(self.claimed or self.names)
+        try:
+            return self.ticket.wait()
+        except Exception:
+            # every fetch on this batch fails together — conservative,
+            # one IO covered them all
+            self.failed = True
+            raise
+
+    def deref(self) -> None:
+        self.refs -= 1
+        if self.refs > 0:
+            return
+        if self.ticket is not None:
+            self.store._reads_inflight -= 1
+            try:
+                self.ticket.release()
+            except Exception:
+                pass                # failure already surfaced via view()
+        # the batch owns its members' chain pins: unpin only once the
+        # shared ticket is dead — an unridden member evicted earlier
+        # would unlink a file the ticket's preads still target
+        for e in self.entries:
+            e.loans -= 1
+            if e.loans == 0 and e.dropped:
+                self.store.discard(e.key)
 
 
 class KVFetch:
@@ -71,16 +134,17 @@ class KVFetch:
     ``wait()`` at the engine's fence instead of up front."""
 
     __slots__ = ("store", "entry", "tier", "t_start", "_ticket", "_lazy",
-                 "_parts", "_released", "eid")
+                 "_batch", "_parts", "_released", "eid")
 
     def __init__(self, store: "KVTierStore", entry: _Entry, tier: str,
-                 ticket=None, lazy: bool = False):
+                 ticket=None, lazy: bool = False, batch=None):
         self.store = store
         self.entry = entry
         self.tier = tier
         self.t_start = time.perf_counter()
         self._ticket = ticket
         self._lazy = lazy
+        self._batch = batch         # _BatchRead this fetch rides, if any
         self._parts: Optional[Dict[str, np.ndarray]] = None
         self._released = False
         # async event-track id: fetch_start -> release is the promote's
@@ -95,6 +159,8 @@ class KVFetch:
 
     @property
     def submitted(self) -> bool:
+        if self._batch is not None:
+            return self._batch.ticket is not None
         return not self._lazy
 
     def _slice_parts(self, blob: np.ndarray) -> Dict[str, np.ndarray]:
@@ -109,6 +175,10 @@ class KVFetch:
             return self._parts
         if self.tier == TIER_HOST:
             blob = self.entry.buf.data[:self.entry.nbytes]
+        elif self._batch is not None:
+            view = self._batch.view()   # submits a lazy batch, may raise
+            off, nb = self._batch.segments[self.entry.name]
+            blob = view[off:off + nb]
         else:
             if self._lazy:
                 self._ticket = self.store._submit_read(self.entry)
@@ -130,7 +200,10 @@ class KVFetch:
             bus.async_end("kv_tier", "kv_fetch", self.eid,
                           args={"tier": self.tier})
             self.eid = None
-        if self.tier == TIER_NVME and self._ticket is not None:
+        if self.tier == TIER_NVME and self._batch is not None:
+            self._batch.deref()     # shared ticket: last holder releases
+            self._batch = None
+        elif self.tier == TIER_NVME and self._ticket is not None:
             self.store._reads_inflight -= 1
             try:
                 self._ticket.release()
@@ -168,13 +241,20 @@ class KVTierStore:
     """
 
     def __init__(self, host_mb: float = 64.0, nvme_path: str = "",
-                 promote_depth: int = 4,
+                 promote_depth: int = 4, nvme_max_mb: float = 0.0,
+                 nvme_ttl_s: float = 0.0,
                  pool: Optional[PinnedBufferPool] = None,
                  swapper: Optional[AsyncTensorSwapper] = None,
                  on_drop: Optional[Callable[[int], None]] = None,
                  instruments: Optional[Dict[str, Dict]] = None):
         self.host_bytes = int(host_mb * (1 << 20))
         self.promote_depth = int(promote_depth)
+        # NVMe bounds (0 = unbounded): without them disk usage is limited
+        # only by discard-on-drop — distinct-prefix churn grows the tier
+        # without limit. Enforced LRU+TTL inside _spill.
+        self.nvme_max_bytes = int(nvme_max_mb * (1 << 20))
+        self.nvme_ttl_s = float(nvme_ttl_s)
+        self._now = time.monotonic   # injectable clock (TTL tests)
         self.pool = pool if pool is not None else PinnedBufferPool()
         self._own_swapper = swapper is None and bool(nvme_path)
         if swapper is not None:
@@ -191,14 +271,20 @@ class KVTierStore:
         self._inst = instruments or {}
         self._ebus = get_bus()   # causal event bus (mutated in place)
         self._host: "OrderedDict[int, _Entry]" = OrderedDict()
-        self._nvme: Dict[int, _Entry] = {}
+        # insertion/touch order = LRU order for the cap enforcement
+        self._nvme: "OrderedDict[int, _Entry]" = OrderedDict()
         self._host_used = 0
         self._nvme_used = 0
         self._reads_inflight = 0
+        self._chain: Optional[_BatchRead] = None  # armed by begin_chain
+        self._chain_pins: List[_Entry] = []       # pinned until end_chain
+        self._chain_active = False                # begin/end_chain nesting
         self.counters: Dict[str, int] = {
             "host_demotions": 0, "nvme_demotions": 0,
             "host_hits": 0, "nvme_hits": 0,
             "host_misses": 0, "nvme_misses": 0, "dropped": 0,
+            "nvme_ttl_dropped": 0, "nvme_cap_dropped": 0,
+            "batched_reads": 0,
         }
 
     # ------------------------------------------------------------------
@@ -254,6 +340,7 @@ class KVTierStore:
             raise
         entry = _Entry(key, off, metas)
         entry.buf = buf
+        entry.touch = self._now()
         self._host[key] = entry
         self._host_used += off
         self._count(TIER_HOST, "demotions")
@@ -305,6 +392,46 @@ class KVTierStore:
                 self._ebus.instant("kv_tier", "spill",
                                    args={"key": key, "bytes": e.nbytes,
                                          "tier": TIER_NVME})
+        self._enforce_nvme_bounds()
+
+    def _evict_nvme(self, e: _Entry, reason: str) -> None:
+        """Drop one NVMe entry for TTL/cap enforcement: the backing file
+        is removed and the radix tree learns via ``on_drop`` (through
+        ``_drop_entry``, which also counts the per-tier miss)."""
+        self._nvme.pop(e.key, None)
+        self._nvme_used -= e.nbytes
+        if e.wticket is not None:
+            try:
+                e.wticket.wait()
+            except Exception:
+                pass
+            e.wticket = None
+        self.swapper.discard(e.name)
+        self.counters[f"nvme_{reason}_dropped"] += 1
+        self._drop_entry(e, TIER_NVME)
+
+    def _enforce_nvme_bounds(self) -> None:
+        """LRU + TTL bounds on the NVMe tier (``tiers.nvme_max_mb`` /
+        ``tiers.nvme_ttl_s``). Entries idle past the TTL go first, then
+        the oldest-touched entries until the tier fits the cap. Entries a
+        live fetch (or an armed promote chain) pins are skipped."""
+        if self.nvme_ttl_s > 0:
+            now = self._now()
+            for k in list(self._nvme):
+                # .get, not [k]: evicting one entry fires on_drop ->
+                # _drop_subtree, which may discard OTHER NVMe entries
+                # (demoted descendants) out from under this snapshot
+                e = self._nvme.get(k)
+                if e is not None and e.loans == 0 \
+                        and now - e.touch > self.nvme_ttl_s:
+                    self._evict_nvme(e, "ttl")
+        if self.nvme_max_bytes > 0:
+            for k in list(self._nvme):   # OrderedDict: oldest touch first
+                if self._nvme_used <= self.nvme_max_bytes:
+                    break
+                e = self._nvme.get(k)    # reentrant discard: see above
+                if e is not None and e.loans == 0:
+                    self._evict_nvme(e, "cap")
 
     def _drop_entry(self, e: _Entry, tier: str) -> None:
         self.counters["dropped"] += 1
@@ -332,6 +459,102 @@ class KVTierStore:
             self._reads_inflight -= 1
             raise
 
+    def _submit_read_many(self, names: List[str]):
+        """Submit one batched ticket for a chain's entries (counts as ONE
+        in-flight read — it is one ticket)."""
+        self._reads_inflight += 1
+        try:
+            return self.swapper.swap_in_start_many(names)
+        except BaseException:
+            self._reads_inflight -= 1
+            raise
+
+    # ------------------------------------------------------------------
+    def begin_chain(self, keys: Sequence[int]) -> bool:
+        """Prepare the store for the promote chain ``PrefixCache.acquire``
+        is about to walk. EVERY present chain entry — host or NVMe — is
+        pinned (``loans``) until :meth:`end_chain`, so the demotions the
+        same acquire triggers (make-room eviction → host spill → NVMe
+        cap/TTL sweep) can neither spill a host member out from under its
+        upcoming fetch nor drop an NVMe member whose read is wanted. When
+        >= 2 members sit on NVMe, their reads additionally arm ONE
+        batched AIO ticket the following ``fetch_start`` calls ride
+        instead of submitting one read each. Pair with :meth:`end_chain`
+        (try/finally). Returns True when anything was pinned."""
+        if self._chain_active or keys is None:
+            return False
+        found = [self._host.get(k) or self._nvme.get(k) for k in keys]
+        found = [e for e in found if e is not None]
+        if not found:
+            return False
+        nvme = []
+        for e in [e for e in found if e.key in self._nvme]:
+            if e.wticket is not None:   # flush in-flight demote writes
+                try:
+                    e.wticket.wait()
+                except Exception as ex:
+                    # the demote write never landed: the file is torn.
+                    # Degrade to a per-block miss (the radix tree drops
+                    # the node and recomputes) exactly like fetch_start's
+                    # failed-submit path — raising here would crash the
+                    # whole serving acquire.
+                    logger.warning(f"kv tier: demote write of {e.name} "
+                                   f"failed ({ex}); dropping the entry")
+                    e.wticket = None
+                    self._count(TIER_NVME, "misses")
+                    self.discard(e.key)
+                    continue
+                e.wticket = None
+            nvme.append(e)
+        pins = [e for e in found
+                if e.key in self._host or e.key in self._nvme]
+        if not pins:
+            return False
+        batched = None
+        if len(nvme) >= 2 and self.swapper is not None:
+            names = [e.name for e in nvme]
+            if self._reads_inflight >= self.promote_depth:
+                # lazy: submit at the first wait (the engine's fence)
+                batched = _BatchRead(self, names, nvme)
+            else:
+                try:
+                    ticket, segments = self._submit_read_many(names)
+                    batched = _BatchRead(self, names, nvme, ticket,
+                                         segments)
+                except Exception as ex:
+                    logger.warning("kv tier: batched promote read failed "
+                                   f"to submit ({ex}); falling back to "
+                                   "per-block reads")
+        # batch members stay pinned by the BATCH until its ticket dies
+        # (last rider release): their reads are already in flight, so an
+        # unridden member evicted after end_chain would unlink a file a
+        # pread is still targeting. Non-batch members unpin at end_chain.
+        if batched is not None:
+            self._chain = batched
+            self.counters["batched_reads"] += 1
+            for e in batched.entries:
+                e.loans += 1
+            pins = [e for e in pins if e not in batched.entries]
+        for e in pins:
+            e.loans += 1
+        self._chain_pins = pins
+        self._chain_active = True
+        return True
+
+    def end_chain(self) -> None:
+        """Release ``begin_chain``'s entry pins and the batch's base
+        reference; batch members stay pinned by the batch itself until
+        its shared ticket releases (last riding fetch)."""
+        pins, self._chain_pins = self._chain_pins, []
+        chain, self._chain = self._chain, None
+        self._chain_active = False
+        for e in pins:
+            e.loans -= 1
+            if e.loans == 0 and e.dropped:
+                self.discard(e.key)
+        if chain is not None:
+            chain.deref()
+
     def fetch_start(self, key: int) -> Optional[KVFetch]:
         """Begin promoting ``key``'s payload back toward HBM. Host entries
         resolve immediately; NVMe entries submit an async ticket read now
@@ -340,13 +563,24 @@ class KVTierStore:
         e = self._host.get(key)
         if e is not None:
             self._host.move_to_end(key)          # promote = hottest
+            e.touch = self._now()
             self._count(TIER_HOST, "hits")
             e.loans += 1
             return KVFetch(self, e, TIER_HOST)
         e = self._nvme.get(key)
         if e is None:
             return None
+        self._nvme.move_to_end(key)              # LRU for the cap sweep
+        e.touch = self._now()
         self._count(TIER_NVME, "hits")
+        chain = self._chain
+        if chain is not None and e in chain.entries:
+            # ride the chain's ONE batched ticket instead of submitting
+            # a read per block
+            e.loans += 1
+            chain.refs += 1
+            chain.claimed.append(e.name)
+            return KVFetch(self, e, TIER_NVME, batch=chain)
         if self._reads_inflight >= self.promote_depth:
             e.loans += 1
             return KVFetch(self, e, TIER_NVME, lazy=True)
@@ -412,6 +646,8 @@ class KVTierStore:
             "host_budget_bytes": self.host_bytes,
             "nvme_entries": len(self._nvme),
             "nvme_bytes": self._nvme_used,
+            "nvme_budget_bytes": self.nvme_max_bytes,
+            "nvme_ttl_s": self.nvme_ttl_s,
             "nvme": self.swapper is not None,
             "reads_inflight": self._reads_inflight,
             "pool": self.pool.report(),

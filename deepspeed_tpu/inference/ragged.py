@@ -289,28 +289,42 @@ class PrefixCache:
         upload and fence before any attention read. The chain truncates at
         the first node that can neither be used nor promoted."""
         path = self._walk(tokens, max_tokens)
-        deficit = (sum(1 for n in path
-                       if not n.resident and n.handle is not None)
-                   - self.allocator.free_blocks)
-        if deficit > 0:
-            # make room for the whole promote chain in ONE pass — the
-            # per-block evict(1) fallback inside _promote rebuilds the
-            # full-tree candidate list every call, O(path x tree) on the
-            # admission hot path under exactly the churn tiers target
-            self.evict(deficit, exclude=path)
+        demoted = [n for n in path
+                   if not n.resident and n.handle is not None]
         usable: List[_PrefixNode] = []
         promotes: List[PromoteRecord] = []
-        for n in path:
-            if n.resident:
+        store = self.tier_store
+        # one AIO ticket for the whole chain's NVMe reads (instead of one
+        # per block): fetch_start inside _promote rides the armed batch.
+        # Armed — and EVERY chain entry pinned, host tier too — before
+        # the deficit eviction below: its demotions trigger host spill
+        # and the NVMe cap/TTL sweep, which must neither move nor drop
+        # the very entries this acquire is about to read.
+        chained = (store is not None and demoted
+                   and store.begin_chain([n.handle for n in demoted]))
+        try:
+            deficit = len(demoted) - self.allocator.free_blocks
+            if deficit > 0:
+                # make room for the whole promote chain in ONE pass — the
+                # per-block evict(1) fallback inside _promote rebuilds the
+                # full-tree candidate list every call, O(path x tree) on
+                # the admission hot path under exactly the churn tiers
+                # target
+                self.evict(deficit, exclude=path)
+            for n in path:
+                if n.resident:
+                    usable.append(n)
+                    continue
+                if n.handle is None:
+                    break           # dead node (stale path reference)
+                rec = self._promote(n, path)
+                if rec is None:
+                    break
+                promotes.append(rec)
                 usable.append(n)
-                continue
-            if n.handle is None:
-                break               # dead node (stale path reference)
-            rec = self._promote(n, path)
-            if rec is None:
-                break
-            promotes.append(rec)
-            usable.append(n)
+        finally:
+            if chained:
+                store.end_chain()
         blocks = [n.block for n in usable]
         if blocks:
             self.allocator.incref(blocks)

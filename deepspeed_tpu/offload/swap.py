@@ -381,19 +381,22 @@ class AsyncTensorSwapper:
         get_injector().on_swap_io(site)
 
     def _submit_chunks(self, kind: str, path: bytes, buf: PinnedBuffer,
-                       nbytes: int, ids: List[int]) -> List[int]:
+                       nbytes: int, ids: List[int],
+                       base: int = 0) -> List[int]:
         """Split ``nbytes`` of ``buf`` into chunk-sized native ops at file
         offsets; one op per chunk spreads a large leaf over all workers.
         Appends into the CALLER's ``ids`` list as each op is queued, so an
         exception mid-loop leaves the already-submitted op ids visible to
-        the caller's cleanup (they still target ``buf``)."""
+        the caller's cleanup (they still target ``buf``). ``base`` offsets
+        the buffer side only (multi-file batch tickets pack several files'
+        payloads into one buffer at aligned segment starts)."""
         submit = (self.lib.ds_aio_submit_pread if kind == "r"
                   else self.lib.ds_aio_submit_pwrite)
         od = 1 if self.o_direct else 0
         off = 0
         while off < nbytes:
             n = min(self.chunk_bytes, nbytes - off)
-            ids.append(submit(self.handle, path, buf.addr(off),
+            ids.append(submit(self.handle, path, buf.addr(base + off),
                               ctypes.c_int64(n), ctypes.c_int64(off), od))
             off += n
         return ids
@@ -459,6 +462,36 @@ class AsyncTensorSwapper:
             self._submit_chunks("r", self._path(name), buf, io_bytes, ids)
             return self._new_ticket("r", name, ids, buf, nbytes, shape,
                                     dtype)
+        except BaseException:
+            self._release_failed_submit(ids, buf)
+            raise
+
+    def swap_in_start_many(self, names: List[str]):
+        """ONE async ticket covering several files' payloads, read into a
+        single pooled buffer at aligned segment offsets — the serving KV
+        tier's per-chain promote batching (one AIO ticket per matched
+        chain instead of one per block). Returns ``(ticket, segments)``
+        where ``segments[name] = (buffer_offset, nbytes)`` indexes into
+        the flat uint8 view ``ticket.wait()`` yields."""
+        self._fire_fault("swap_read")
+        segments: Dict[str, tuple] = {}
+        total = 0
+        for name in names:
+            shape, dtype = self._meta[name]
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            segments[name] = (total, nbytes)
+            # every segment starts _ALIGN-padded so O_DIRECT stays legal
+            total += _padded(nbytes)
+        buf = self.pool.get(total)
+        ids: List[int] = []
+        try:
+            for name in names:
+                base, nbytes = segments[name]
+                io_bytes = _padded(nbytes) if self.o_direct else nbytes
+                self._submit_chunks("r", self._path(name), buf, io_bytes,
+                                    ids, base=base)
+            return (self._new_ticket("r", f"batch[{len(names)}]", ids, buf,
+                                     total, (total,), np.uint8), segments)
         except BaseException:
             self._release_failed_submit(ids, buf)
             raise

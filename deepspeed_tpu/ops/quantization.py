@@ -147,56 +147,6 @@ def unpack_fp6(packed: jax.Array, n: int) -> jax.Array:
     return c.reshape(-1)[:n].astype(jnp.uint8)
 
 
-# ---------------------------------------------------------------------------
-# Quantized collectives (ZeRO++ qwZ / qgZ parity) — call inside shard_map.
-# ---------------------------------------------------------------------------
-
-def all_gather_quantized(x: jax.Array, axis: str, bits: int = 8,
-                         group_size: int = 2048, dim: int = 0,
-                         axis_index_groups=None) -> jax.Array:
-    """qwZ: quantize → all_gather → dequantize (partition_parameters.py:820
-    QuantizationInfo parity). Cuts DCN all-gather volume ~2×(int8)/4×(int4).
-
-    ``dim`` is the tensor dimension the gathered shards tile (matching
-    ``lax.all_gather(..., axis=dim, tiled=True)``); ``axis_index_groups``
-    restricts the gather to sub-groups of the mesh axis (the hpZ intra-node
-    secondary-partition gather, ``utils/groups.py:859``).
-    """
-    from jax import lax
-
-    q, scale = quantize_blockwise(x, bits=bits, group_size=group_size)
-    qg = lax.all_gather(q, axis, axis=0, tiled=False,
-                        axis_index_groups=axis_index_groups)
-    sg = lax.all_gather(scale, axis, axis=0, tiled=False,
-                        axis_index_groups=axis_index_groups)
-    n = qg.shape[0]
-
-    def deq(i):
-        return dequantize_blockwise(qg[i], sg[i], bits=bits, shape=x.shape,
-                                    dtype=x.dtype)
-
-    return jnp.concatenate([deq(i) for i in range(n)], axis=dim)
-
-
-def reduce_scatter_quantized(x: jax.Array, axis: str, bits: int = 8,
-                             group_size: int = 2048, dim: int = 0) -> jax.Array:
-    """qgZ: all-to-all int-quantized gradient chunks, dequant-reduce locally
-    (``runtime/comm/coalesced_collectives.py:31`` ``all_to_all_quant_reduce``).
-    One quantized a2a replaces the ring reduce-scatter: volume /= (32/bits).
-    ``dim`` is the tensor dimension being scattered."""
-    from jax import lax
-
-    if dim != 0:
-        x = jnp.moveaxis(x, dim, 0)
-    world = lax.axis_size(axis)
-    chunks = x.reshape((world, x.shape[0] // world) + x.shape[1:])
-    q, scale = jax.vmap(lambda c: quantize_blockwise(c, bits=bits,
-                                                     group_size=group_size))(chunks)
-    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
-    st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=False)
-    deq = jax.vmap(lambda qq, ss: dequantize_blockwise(
-        qq, ss, bits=bits, shape=chunks.shape[1:], dtype=jnp.float32))(qt, st)
-    out = deq.sum(axis=0).astype(x.dtype)
-    if dim != 0:
-        out = jnp.moveaxis(out, 0, dim)
-    return out
+# The quantized collectives (ZeRO++ qwZ / qgZ) live in
+# ``deepspeed_tpu/comm/quantized.py`` — the LOGGED wire layer built on the
+# blockwise kernels above (so comm/<op>_bytes accounts their payloads).

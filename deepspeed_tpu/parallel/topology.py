@@ -40,6 +40,18 @@ class Topology:
 
     mesh: "jax.sharding.Mesh"  # noqa: F821
     axis_sizes: Dict[str, int]
+    # per-axis ICI extent: on a multi-slice (DCN-connected) mesh an axis
+    # of size s with DCN factor f is laid out as f slice-groups of s/f
+    # ICI-adjacent devices — ici_sizes[ax] = s/f. None = single slice
+    # (every axis fully on ICI). ZeRO++ reads this to place its hpZ
+    # secondary partition and qgZ two-hop split on the slice boundary.
+    ici_sizes: Optional[Dict[str, int]] = None
+
+    def ici_size(self, axis: str) -> int:
+        """Devices per slice along ``axis`` (== axis size when all-ICI)."""
+        if self.ici_sizes is not None and axis in self.ici_sizes:
+            return self.ici_sizes[axis]
+        return self.axis_sizes.get(axis, 1)
 
     @property
     def world_size(self) -> int:
@@ -125,14 +137,16 @@ def build_mesh(mesh_config: Optional[MeshConfig] = None,
                 f"num_slices (ep/sp/tp are pinned to ICI)")
         device_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices)
+        ici_sizes = dict(zip(MESH_AXES, ici_shape))
     else:
         try:
             device_array = mesh_utils.create_device_mesh(shape, devices=devices)
         except Exception:
             device_array = np.asarray(devices).reshape(shape)
+        ici_sizes = None
 
     mesh = Mesh(device_array, MESH_AXES)
-    topo = Topology(mesh=mesh, axis_sizes=sizes)
+    topo = Topology(mesh=mesh, axis_sizes=sizes, ici_sizes=ici_sizes)
     log_dist(f"built mesh: {topo}")
     return topo
 

@@ -10,24 +10,34 @@ Parity target: the three ZeRO++ features of the reference —
     ``partition_parameters.py`` ``zero_hpz_partition_size``).
 
 TPU-native design: GSPMD's auto partitioner cannot express *lossy* collectives,
-so when any ZeRO++ feature is on the engine swaps its fwd/bwd program for a
+so when the ``zero_pp`` region is on the engine swaps its fwd/bwd program for a
 ``shard_map`` that is MANUAL over the batch axes (``dp``, ``fsdp``) and auto
 over everything else — tp/sp/ep stay ordinary GSPMD inside the body. In the
 manual region the param all-gather and grad reduce-scatter that XLA would have
-inserted become explicit calls, which we replace with their int8/int4
-quantized forms (``ops/quantization.py``):
+inserted become explicit calls through the LOGGED quantized wire layer
+(``comm/quantized.py`` — every op records its actual packed payload with the
+comms logger at trace time, so the ``comm/<op>_bytes`` counters measure the
+compression for real; with every feature off the region is the dense
+bf16-collective baseline):
 
   * **qwZ**: params at rest stay fsdp-sharded (ZeRO-3); the body all-gathers
-    the tree once per step through ``all_gather_quantized``.
+    the tree once per step through ``all_gather_q`` (int8/int4 blockwise —
+    the same kernels that quantize served weights, so training-side quant
+    error characteristics match the served models).
   * **qgZ**: each grad leaf is reduced with a quantized all-to-all
     reduce-scatter over ``fsdp`` (+ a plain psum over ``dp``); payload on the
-    zero axis shrinks by 32/bits.
+    zero axis shrinks by 32/bits. On a sliced mesh this is TWO-hop:
+    intra-slice reduce in bf16 over ICI, inter-slice quantized over DCN — so
+    quantization error enters once, on the slow hop, and never accumulates
+    across the fast axis.
   * **hpZ**: a bf16 *secondary* copy of each fsdp-sharded param lives sharded
-    1/k per device (k = ``zero_hpz_partition_size``, the intra-node group
-    width). Per-step forward all-gathers ride the k-wide contiguous groups
-    (ICI); the cross-group gather happens once per optimizer step when the
-    secondary is refreshed from the updated primary shards — the exact traffic
-    shape hpZ exists for, mapped onto mesh ``axis_index_groups``.
+    1/k per device (k = ``zero_pp.hpz_partition_size``, default the ICI slice
+    extent of the fsdp axis — "slice-local"). Per-step forward all-gathers
+    ride the k-wide contiguous groups (ICI, logged ``all_gather_intra``); the
+    cross-group gather happens once per optimizer step when the secondary is
+    refreshed from the updated primary shards (quantized under qwZ) — the
+    exact traffic shape hpZ exists for, mapped onto mesh
+    ``axis_index_groups``.
 
 The secondary copy is stored as a global array of shape ``[fsdp, *slice]``
 sharded ``P('fsdp')`` on the leading axis: each device's row IS its 1/k
@@ -41,7 +51,7 @@ a static reshape/transpose.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,16 +59,21 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.ops.quantization import (all_gather_quantized,
-                                            reduce_scatter_quantized)
+from deepspeed_tpu.comm import quantized as cq
 from deepspeed_tpu.parallel.sharding import spec_axes
+from deepspeed_tpu.utils.logging import log_dist
 
 MANUAL_AXES = ("dp", "fsdp")
 
 
 def enabled(zcfg) -> bool:
-    return bool(zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients
-                or zcfg.zero_hpz_partition_size > 1)
+    """The explicit-collective region is on: ``zero_pp.enabled`` (the
+    validator folds the reference's flat ``zero_quantized_*`` /
+    ``zero_hpz_partition_size`` knobs into the block, so this is the one
+    switch). enabled with every feature off = the logged bf16-collective
+    baseline."""
+    zpp = getattr(zcfg, "zero_pp", None)
+    return bool(zpp is not None and zpp.enabled)
 
 
 def _axis_dim(spec: Optional[P], axis: str) -> Optional[int]:
@@ -88,14 +103,10 @@ def _restrict(spec: Optional[P], keep: Sequence[str]) -> P:
     return P(*entries)
 
 
-def _intra_groups(n: int, k: int):
-    """Contiguous groups of k devices (the 'node' of hpZ's secondary group)."""
-    return [list(range(g * k, (g + 1) * k)) for g in range(n // k)]
-
-
-def _cross_groups(n: int, k: int):
-    """Strided groups {j, j+k, …}: the once-per-step secondary refresh gather."""
-    return [[j + m * k for m in range(n // k)] for j in range(k)]
+# group arithmetic lives with the wire layer (comm/quantized.py) so the
+# drill/tests compute the same memberships the plan communicates over
+_intra_groups = cq.intra_groups
+_cross_groups = cq.cross_groups
 
 
 def _unpermute(x: jax.Array, dim: int, k: int, n: int) -> jax.Array:
@@ -119,26 +130,60 @@ class ZeroPPPlan:
     hpz_refresh: Optional[Callable]  # jitted params -> secondary tree (or None)
     hpz_sharding: Optional[Any]      # NamedSharding tree for the secondary copy
     uses_secondary: bool             # forward consumes the hpZ secondary tree
+    features: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # jitted tree -> scalar relative-L2 roundtrip error at the configured
+    # bits/block (largest leaf); keys "qwz"/"qgz" present when the
+    # feature is on — the engine's quant-error gauges
+    quant_error_fns: Dict[str, Callable] = dataclasses.field(
+        default_factory=dict)
 
 
 def build_plan(model, topology, param_spec_tree, grad_spec_tree, zcfg,
                compute_dtype=jnp.bfloat16) -> Optional[ZeroPPPlan]:
-    """Build the ZeRO++ step plan, or None when no feature is active / no
-    manual axis has size > 1 (nothing to compress on a single data shard)."""
+    """Build the ZeRO++ explicit-collective step plan, or None when the
+    region is off / no manual axis has size > 1 (nothing to communicate
+    on a single data shard). Collectives all flow through the logged
+    ``comm.quantized`` layer; with every feature off the plan is the
+    dense bf16-collective baseline."""
     if not enabled(zcfg):
         return None
     manual = tuple(a for a in MANUAL_AXES if topology.axis_sizes.get(a, 1) > 1)
     if not manual:
         return None
     mesh = topology.mesh
-    qw = bool(zcfg.zero_quantized_weights)
-    qg = bool(zcfg.zero_quantized_gradients)
-    k = int(zcfg.zero_hpz_partition_size)
+    zpp = zcfg.zero_pp
+    qw, qg = bool(zpp.qwz), bool(zpp.qgz)
+    wb, gb, bs = int(zpp.weight_bits), int(zpp.grad_bits), int(zpp.block_size)
+    xonly = bool(zpp.cross_slice_only)
     nf = topology.axis_sizes.get("fsdp", 1)
-    hpz = k > 1 and "fsdp" in manual
+    # slice extent of the fsdp axis: the qgZ two-hop split point and the
+    # hpZ default partition. Derived from the mesh's ICI layout unless
+    # overridden (tests/drills simulate slices on flat hardware). An
+    # explicit slice_size that cannot tile the axis is a LOUD error —
+    # clamping it would silently disable the two-hop split (no DCN
+    # reduction, no warning). Ignored when the fsdp axis is trivial.
+    s = int(zpp.slice_size)
+    if s and nf > 1 and (s > nf or nf % s != 0):
+        raise ValueError(
+            f"zero_pp.slice_size={s} must divide the fsdp axis ({nf})")
+    if not s:
+        s = topology.ici_size("fsdp")
+    s = min(max(s, 1), nf)
+    k = int(zpp.hpz_partition_size) or s
+    hpz = bool(zpp.hpz) and "fsdp" in manual
     if hpz and nf % k != 0:
         raise ValueError(
-            f"zero_hpz_partition_size={k} must divide the fsdp axis ({nf})")
+            f"hpZ partition size {k} (zero_pp.hpz_partition_size / "
+            f"zero_hpz_partition_size) must divide the fsdp axis ({nf})")
+    if hpz and k >= nf:
+        # single-slice mesh (or k covering the whole axis): the secondary
+        # would coincide with the primary partition — graceful fallback
+        log_dist("zero_pp.hpz: partition size equals the fsdp axis "
+                 f"({k}); secondary shard disabled (single-slice mesh)")
+        hpz = False
+    if hpz and k <= 1:
+        hpz = False
+    two_hop = qg and s < nf    # a slice structure exists: split the reduce
     dp_world = int(np.prod([topology.axis_sizes[a] for a in manual]))
 
     pspecs = param_spec_tree
@@ -149,21 +194,40 @@ def build_plan(model, topology, param_spec_tree, grad_spec_tree, zcfg,
         d = _axis_dim(spec, "fsdp")
         if d is None or "fsdp" not in manual:
             return x
-        if qw:
-            return all_gather_quantized(x.astype(compute_dtype), "fsdp", dim=d)
-        return lax.all_gather(x, "fsdp", axis=d, tiled=True)
+        xb = x.astype(compute_dtype)
+        if qw and xonly:
+            if s < nf:
+                # quantize only the DCN hop; the ICI gather stays dense
+                return cq.two_hop_all_gather(xb, "fsdp", s, bits=wb,
+                                             block_size=bs, gather_dim=d)
+            # single-slice mesh: the full-axis gather never leaves ICI —
+            # dense, and charged to the intra counter (mirror of the
+            # reduce path's relabel, so the DCN-volume counters stay
+            # meaningful)
+            return cq.all_gather_dense(xb, "fsdp", gather_dim=d,
+                                       op="all_gather_intra")
+        if qw and not xonly:
+            return cq.all_gather_q(xb, "fsdp", bits=wb, block_size=bs,
+                                   gather_dim=d)
+        return cq.all_gather_dense(xb, "fsdp", gather_dim=d)
 
     def gather_secondary(x, spec):
         d = _sole_fsdp_dim(spec)
         if d is None:
             return gather_primary(x, spec)
-        s = x[0]  # local 1/k secondary shard (leading device axis is manual)
-        if qw:
-            g = all_gather_quantized(s, "fsdp", dim=d,
-                                     axis_index_groups=_intra_groups(nf, k))
+        sblk = x[0]  # local 1/k secondary shard (leading device axis is manual)
+        # the per-step secondary gather is slice-local by construction —
+        # quantize it only when quantization is not restricted to the
+        # cross-slice hops
+        if qw and not xonly:
+            g = cq.all_gather_q(sblk, "fsdp", bits=wb, block_size=bs,
+                                gather_dim=d,
+                                axis_index_groups=_intra_groups(nf, k),
+                                op="all_gather_intra")
         else:
-            g = lax.all_gather(s, "fsdp", axis=d, tiled=True,
-                               axis_index_groups=_intra_groups(nf, k))
+            g = cq.all_gather_dense(sblk, "fsdp", gather_dim=d,
+                                    axis_index_groups=_intra_groups(nf, k),
+                                    op="all_gather_intra")
         return _unpermute(g, d, k, nf)
 
     # ---- per-leaf grad reduce (qgZ) ------------------------------------
@@ -173,12 +237,25 @@ def build_plan(model, topology, param_spec_tree, grad_spec_tree, zcfg,
             g = lax.psum(g, "dp")
         if "fsdp" in manual:
             d = _axis_dim(spec, "fsdp")
-            if d is not None and qg:
-                g = reduce_scatter_quantized(g, "fsdp", dim=d)
-            elif d is not None:
-                g = lax.psum_scatter(g, "fsdp", scatter_dimension=d, tiled=True)
-            else:
+            if d is None:
                 g = lax.psum(g, "fsdp")
+            elif two_hop:
+                # intra-slice reduce in bf16 over ICI, inter-slice
+                # QUANTIZED over DCN: quantization error enters once, on
+                # the slow hop, never accumulating across the fast axis
+                g = cq.two_hop_reduce_scatter(
+                    g.astype(jnp.bfloat16), "fsdp", s, bits=gb,
+                    block_size=bs, scatter_dim=d).astype(jnp.float32)
+            elif qg and not xonly:
+                g = cq.reduce_scatter_q(g, "fsdp", bits=gb, block_size=bs,
+                                        scatter_dim=d)
+            else:
+                # dense (baseline, or qgZ restricted to cross-slice on a
+                # single-slice mesh where nothing crosses DCN)
+                g = cq.reduce_scatter_dense(
+                    g, "fsdp", scatter_dim=d,
+                    op="reduce_scatter_intra" if (qg and xonly)
+                    else "reduce_scatter")
         return g / dp_world
 
     gather = gather_secondary if hpz else gather_primary
@@ -219,9 +296,17 @@ def build_plan(model, topology, param_spec_tree, grad_spec_tree, zcfg,
             d = _sole_fsdp_dim(spec)
             if d is None:
                 return x.astype(compute_dtype)
-            s = lax.all_gather(x, "fsdp", axis=d, tiled=True,
-                               axis_index_groups=_cross_groups(nf, k))
-            return s[None].astype(compute_dtype)
+            xb = x.astype(compute_dtype)
+            # the refresh IS the cross-slice gather hpZ amortizes to once
+            # per optimizer step — with qwZ it rides the wire quantized
+            if qw:
+                g = cq.all_gather_q(xb, "fsdp", bits=wb, block_size=bs,
+                                    gather_dim=d,
+                                    axis_index_groups=_cross_groups(nf, k))
+            else:
+                g = cq.all_gather_dense(xb, "fsdp", gather_dim=d,
+                                        axis_index_groups=_cross_groups(nf, k))
+            return g[None]
 
         def refresh_body(params):
             return jax.tree_util.tree_map(
@@ -281,5 +366,26 @@ def build_plan(model, topology, param_spec_tree, grad_spec_tree, zcfg,
             out_specs=(grad_out_specs, P()),
             axis_names=set(manual), check_vma=False)(params_in, batch, scale)
 
-    return ZeroPPPlan(manual=manual, grads_fn=grads_fn, hpz_refresh=hpz_refresh,
-                      hpz_sharding=hpz_sharding, uses_secondary=hpz)
+    # ---- quant-error gauges (engine: train/qwz|qgz_quant_error) --------
+    def _largest_leaf_error(tree, bits):
+        leaves = [l for l in jax.tree_util.tree_leaves(tree)
+                  if hasattr(l, "size")]
+        big = max(leaves, key=lambda l: l.size)
+        return cq.quant_roundtrip_error(big, bits=bits, block_size=bs)
+
+    quant_error_fns: Dict[str, Callable] = {}
+    if qw:
+        quant_error_fns["qwz"] = jax.jit(
+            lambda tree: _largest_leaf_error(tree, wb))
+    if qg:
+        quant_error_fns["qgz"] = jax.jit(
+            lambda tree: _largest_leaf_error(tree, gb))
+
+    return ZeroPPPlan(
+        manual=manual, grads_fn=grads_fn, hpz_refresh=hpz_refresh,
+        hpz_sharding=hpz_sharding, uses_secondary=hpz,
+        features={"qwz": qw, "qgz": qg, "hpz": hpz, "weight_bits": wb,
+                  "grad_bits": gb, "block_size": bs, "slice_size": s,
+                  "hpz_partition_size": k if hpz else 0,
+                  "two_hop": two_hop, "cross_slice_only": xonly},
+        quant_error_fns=quant_error_fns)

@@ -81,7 +81,7 @@ class DeepSpeedTpuEngine:
                     " — MiCS on a named mesh IS {'fsdp': mics_shard_size, "
                     "'dp': world // mics_shard_size}; set the mesh to match")
             if (config.zero_optimization.mics_hierarchical_params_gather
-                    and config.zero_optimization.zero_hpz_partition_size <= 1):
+                    and not config.zero_optimization.zero_pp.hpz):
                 raise ValueError(
                     "mics_hierarchical_params_gather needs "
                     "zero_hpz_partition_size > 1 (the hierarchical gather is "
@@ -810,6 +810,15 @@ class DeepSpeedTpuEngine:
                     self.params, self.opt_state, self._grad_acc, denom)
             self._finish_step(gnorm, jnp.zeros((), bool))
             return
+        if (self._obs is not None and self._zpp is not None
+                and "qgz" in self._zpp.quant_error_fns
+                and (self.global_steps + 1)
+                % self.config.steps_per_print == 0):
+            # sample the qgZ roundtrip error on the real grad accumulator
+            # BEFORE _apply donates its buffers (print cadence only)
+            with jax.sharding.set_mesh(self.mesh):
+                self._qgz_err = float(
+                    self._zpp.quant_error_fns["qgz"](self._grad_acc))
         with jax.sharding.set_mesh(self.mesh):
             (self.params, self.opt_state, self.scaler_state, gnorm,
              skipped) = self._apply(self.params, self.opt_state, self._grad_acc,
@@ -838,11 +847,15 @@ class DeepSpeedTpuEngine:
                 self.global_steps = max(0, self.global_steps - 1)
 
     def _refresh_hpz(self) -> None:
-        """Rebuild the hpZ secondary (intra-node) bf16 param copy from the
-        primary shards — the once-per-step cross-group gather hpZ amortizes."""
+        """Rebuild the hpZ secondary (slice-local) bf16 param copy from the
+        primary shards — the once-per-step cross-group gather hpZ amortizes
+        (quantized under qwZ). Host-side dispatch time feeds the
+        ``train/quant_comm_ms`` gauge."""
         if self._zpp is not None and self._zpp.uses_secondary:
+            t0 = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):
                 self._hpz_secondary = self._zpp.hpz_refresh(self.params)
+            self._quant_comm_ms = (time.perf_counter() - t0) * 1e3
 
     def _finish_step(self, gnorm, skipped):
         self._grad_acc = None
@@ -1160,6 +1173,11 @@ class DeepSpeedTpuEngine:
         self._breakdown = bool(ocfg.enabled and (
             ocfg.train_breakdown or config.wall_clock_breakdown))
         self._opt_ms: Optional[float] = None
+        # _refresh_hpz may already have run during init (it stamps the
+        # refresh dispatch time) — keep that first sample
+        self._quant_comm_ms: Optional[float] = getattr(
+            self, "_quant_comm_ms", None)
+        self._qgz_err: Optional[float] = None
         self._last_commit_t: Optional[float] = None
         # baseline NOW, not 0: the comms logger is a process singleton, and
         # latency recorded before this engine existed (a previous engine,
@@ -1198,6 +1216,19 @@ class DeepSpeedTpuEngine:
             "skipped_steps": g("train/skipped_steps",
                                "overflow/guard-skipped steps"),
         }
+        if self._zpp is not None:
+            # ZeRO++ instruments: in-jit quantized collectives are
+            # compiler-scheduled (their volume lands in comm/<op>_bytes);
+            # the one EAGER quantized collective is the hpZ secondary
+            # refresh, timed host-side like the other breakdown gauges
+            self._obs["quant_comm_ms"] = g(
+                "train/quant_comm_ms",
+                "eager quantized-collective dispatch (hpZ refresh)")
+            for feat in self._zpp.quant_error_fns:
+                self._obs[f"{feat}_quant_error"] = g(
+                    f"train/{feat}_quant_error",
+                    f"blockwise {feat} quantize/dequantize relative L2 "
+                    "error (largest leaf, steps_per_print cadence)")
         if self.monitor is not None:
             # serving/* belongs to a co-resident batcher's bridge (its own
             # step axis); flushing it here too would interleave conflicting
@@ -1232,6 +1263,9 @@ class DeepSpeedTpuEngine:
         if self._opt_ms is not None:
             o["optimizer_ms"].set(self._opt_ms)
             self._opt_ms = None
+        if self._quant_comm_ms is not None and "quant_comm_ms" in o:
+            o["quant_comm_ms"].set(self._quant_comm_ms)
+            self._quant_comm_ms = None
         if self._breakdown:
             for timer, key in (("fwd", "fwd_ms"), ("bwd", "bwd_ms")):
                 if self.wall_timers.has(timer):
@@ -1250,6 +1284,18 @@ class DeepSpeedTpuEngine:
             if self._last_loss is not None:
                 o["loss"].set(float(self._last_loss))
             o["lr"].set(float(self.get_lr()[0]))
+            if self._zpp is not None:
+                # quant-error gauges ride the print cadence where the
+                # float() sync is already paid; qwZ error samples the
+                # params, qgZ error the pre-apply grad accumulator
+                # (stamped by step() — fused paths keep grads in-jit)
+                fn = self._zpp.quant_error_fns.get("qwz")
+                if fn is not None:
+                    with jax.sharding.set_mesh(self.mesh):
+                        o["qwz_quant_error"].set(float(fn(self.params)))
+                if self._qgz_err is not None:
+                    o["qgz_quant_error"].set(self._qgz_err)
+                    self._qgz_err = None
         if self._profile_trigger is not None:
             self._profile_trigger.check(self.global_steps)
         if self._obs_bridge is not None:

@@ -38,6 +38,30 @@ class TestPerOpCompletion:
         ra.release()
         rb.release()
         assert sw.pool.outstanding == 0
+
+    @pytest.mark.parametrize("o_direct", [False, True])
+    def test_swap_in_start_many_batched_roundtrip(self, tmp_path, o_direct):
+        """ONE multi-file ticket (the KV tier's per-chain promote batch):
+        every file's payload lands bit-exact at its aligned segment offset
+        in the shared buffer, buffered and O_DIRECT."""
+        from deepspeed_tpu.offload import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path), num_threads=2, chunk_mb=1,
+                                o_direct=o_direct)
+        rng = np.random.default_rng(7)
+        arrays = {f"leaf{i}": rng.normal(size=n).astype(np.float32)
+                  for i, n in enumerate((1000, 70_000, 333))}  # odd tails
+        for name, a in arrays.items():
+            sw.swap_out(name, a).wait()
+        ticket, segs = sw.swap_in_start_many(list(arrays))
+        view = ticket.wait()
+        for name, a in arrays.items():
+            off, nb = segs[name]
+            got = view[off:off + nb].view(np.float32)
+            np.testing.assert_array_equal(got, a)
+        ticket.release()
+        assert sw.pool.outstanding == 0
+        sw.close()
         sw.close()
 
     def test_write_does_not_fence_read(self, tmp_path):

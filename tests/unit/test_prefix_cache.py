@@ -653,6 +653,306 @@ class TestKVTierStore:
         assert store.pool.report()["outstanding"] == 0
 
 
+class TestNvmeBoundsAndBatchedPromotes:
+    """PR-12 follow-ups: NVMe entry cap + TTL (tiers.nvme_max_mb /
+    tiers.nvme_ttl_s, LRU+TTL enforced in _spill) and one AIO ticket per
+    promote chain instead of one per block."""
+
+    def test_nvme_cap_lru_drops_oldest(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        dropped = []
+        # host holds ~1 entry; NVMe capped at ~2 entries (64 B payloads)
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            nvme_max_mb=150 / 2**20,
+                            on_drop=dropped.append)
+        for i in range(6):
+            store.put(i, _payload(i))
+        rep = store.report()
+        assert rep["nvme_cap_dropped"] >= 1
+        assert rep["nvme_bytes"] <= store.nvme_max_bytes
+        assert dropped and dropped == sorted(dropped)   # oldest-first LRU
+        # survivors still fetch bit-exact
+        live = [k for k in range(6) if store.tier_of(k) == "nvme"]
+        assert live
+        f = store.fetch_start(live[-1])
+        assert np.array_equal(f.wait()["k"], _payload(live[-1])["k"])
+        f.release()
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_nvme_ttl_drops_idle_entries(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        clock = [0.0]
+        dropped = []
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            nvme_ttl_s=10.0, on_drop=dropped.append)
+        store._now = lambda: clock[0]
+        store.put(0, _payload(0))
+        store.put(1, _payload(1))           # 0 spills to NVMe
+        assert store.tier_of(0) == "nvme"
+        clock[0] = 5.0
+        f = store.fetch_start(0)            # touch refreshes the TTL clock
+        f.wait()
+        f.release()
+        clock[0] = 12.0                     # 0 idle 7s, fresh enough
+        store.put(2, _payload(2))           # spill -> bounds sweep
+        assert store.has(0)
+        clock[0] = 30.0                     # idle 18s > ttl
+        store.put(3, _payload(3))
+        assert not store.has(0)
+        assert store.counters["nvme_ttl_dropped"] >= 1
+        assert 0 in dropped
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_chain_batches_reads_into_one_ticket(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path))
+        for i in range(4):
+            store.put(i, _payload(i))
+        keys = [k for k in range(4) if store.tier_of(k) == "nvme"]
+        assert len(keys) >= 3
+        singles, batches = [], []
+        orig_one = store.swapper.swap_in_start
+        orig_many = store.swapper.swap_in_start_many
+        store.swapper.swap_in_start = \
+            lambda n: singles.append(n) or orig_one(n)
+        store.swapper.swap_in_start_many = \
+            lambda ns: batches.append(list(ns)) or orig_many(ns)
+        assert store.begin_chain(keys)
+        try:
+            fetches = [store.fetch_start(k) for k in keys]
+            for k, f in zip(keys, fetches):
+                assert f.tier == "nvme"
+                assert np.array_equal(f.wait()["k"], _payload(k)["k"])
+        finally:
+            store.end_chain()
+        for f in fetches:
+            f.release()
+        assert len(batches) == 1 and len(batches[0]) == len(keys)
+        assert not singles                   # ONE ticket for the chain
+        assert store.counters["batched_reads"] == 1
+        assert store._reads_inflight == 0
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_chain_lazy_past_promote_depth(self, tmp_path):
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            promote_depth=1)
+        for i in range(4):
+            store.put(i, _payload(i))
+        keys = [k for k in range(4) if store.tier_of(k) == "nvme"][:2]
+        blocker = store.fetch_start(keys[0])     # occupies the one slot
+        assert store.begin_chain(keys)           # arms LAZY (depth hit)
+        try:
+            f = store.fetch_start(keys[1])
+            assert f._batch is not None and f._batch.ticket is None
+            blocker.wait()
+            blocker.release()
+            # first wait submits the batch at the fence
+            assert np.array_equal(f.wait()["k"], _payload(keys[1])["k"])
+        finally:
+            store.end_chain()
+        f.release()
+        assert store._reads_inflight == 0
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_nvme_bounds_survive_reentrant_discard(self, tmp_path):
+        """Evicting one NVMe entry fires on_drop -> _drop_subtree, which
+        can discard OTHER NVMe entries (demoted descendants) while the
+        TTL/cap sweep iterates its key snapshot — the sweep must skip
+        the vanished keys, not KeyError on the serving hot path."""
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        clock = [0.0]
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            nvme_ttl_s=5.0)
+        store._now = lambda: clock[0]
+        # dropping either of {0, 1} discards the other (the radix tree
+        # dropping a parent's demoted descendant subtree)
+        store.on_drop = lambda k: store.discard(1 - k) if k in (0, 1) \
+            else None
+        for i in range(3):
+            store.put(i, _payload(i))
+        assert store.tier_of(0) == "nvme" and store.tier_of(1) == "nvme"
+        clock[0] = 30.0                       # both expired
+        store.put(3, _payload(3))             # sweep runs — must not raise
+        assert not store.has(0) and not store.has(1)
+        assert store.counters["nvme_ttl_dropped"] >= 1
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_lazy_chain_submits_only_ridden_names(self, tmp_path):
+        """A LAZY batch submits at the first rider's fence-time wait —
+        by then end_chain has unpinned the chain members nothing rode,
+        and those may have been evicted (their _meta gone). The submit
+        must cover only the CLAIMED names or one stale member poisons
+        every intact rider."""
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            promote_depth=1)
+        for i in range(5):
+            store.put(i, _payload(i))
+        keys = [k for k in range(5) if store.tier_of(k) == "nvme"]
+        assert len(keys) >= 4
+        blocker = store.fetch_start(keys[0])   # occupies the one slot
+        assert store.begin_chain(keys[1:4])    # arms LAZY
+        try:
+            f1 = store.fetch_start(keys[1])
+            f2 = store.fetch_start(keys[2])    # keys[3] never ridden
+        finally:
+            store.end_chain()
+        store.discard(keys[3])                 # unridden member vanishes
+        blocker.wait()
+        blocker.release()
+        assert np.array_equal(f1.wait()["k"], _payload(keys[1])["k"])
+        assert np.array_equal(f2.wait()["k"], _payload(keys[2])["k"])
+        f1.release()
+        f2.release()
+        assert store._reads_inflight == 0
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_begin_chain_survives_failed_demote_write(self, tmp_path):
+        """A torn demote write (failed wticket) must degrade to a
+        per-block tier miss inside begin_chain — raising would crash the
+        whole serving acquire, and the pre-existing single-read paths
+        already degrade."""
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path))
+        for i in range(5):
+            store.put(i, _payload(i))
+        keys = [k for k in range(5) if store.tier_of(k) == "nvme"]
+        assert len(keys) >= 3
+
+        class BoomTicket:
+            def wait(self):
+                raise IOError("torn demote write")
+
+        store._nvme[keys[0]].wticket = BoomTicket()
+        assert store.begin_chain(keys)        # must not raise
+        try:
+            assert not store.has(keys[0])     # torn entry -> miss/drop
+            assert store.counters["nvme_misses"] >= 1
+            f = store.fetch_start(keys[1])    # survivors still serve
+            assert np.array_equal(f.wait()["k"], _payload(keys[1])["k"])
+        finally:
+            store.end_chain()
+        f.release()
+        assert store._reads_inflight == 0
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_unridden_batch_members_pinned_until_ticket_release(
+            self, tmp_path):
+        """An EAGER batch submits preads for every chain member; members
+        nothing rode must stay pinned past end_chain until the shared
+        ticket dies — evicting one would unlink a file a pread still
+        targets (AsyncTensorSwapper.discard's documented contract)."""
+        from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+        # cap holds the 3-entry chain (64 B each) with no slack for more
+        store = KVTierStore(host_mb=100 / 2**20, nvme_path=str(tmp_path),
+                            nvme_max_mb=200 / 2**20)
+        for i in range(4):
+            store.put(i, _payload(i))
+        keys = [k for k in range(4) if store.tier_of(k) == "nvme"][:3]
+        assert len(keys) == 3
+        assert store.begin_chain(keys)
+        f = store.fetch_start(keys[0])        # only keys[0] rides
+        store.end_chain()
+        # cap pressure while the shared ticket is alive: the unridden
+        # members' reads are in flight — the sweep must skip them
+        store.put(8, _payload(8))
+        store.put(9, _payload(9))
+        assert store.has(keys[1]) and store.has(keys[2])
+        assert np.array_equal(f.wait()["k"], _payload(keys[0])["k"])
+        f.release()                           # ticket dies: members unpin
+        store.put(10, _payload(10))           # sweep can now enforce cap
+        assert store.report()["nvme_bytes"] <= store.nvme_max_bytes
+        assert store._reads_inflight == 0
+        store.close()
+        assert store.pool.report()["outstanding"] == 0
+
+    def test_acquire_pins_chain_before_deficit_eviction(self):
+        """acquire's make-room eviction demotes blocks, which can push
+        the NVMe tier over its cap — the LRU sweep must not drop the
+        very chain entries this acquire is about to promote (they are
+        the LRU-oldest). begin_chain pins them FIRST."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as nvme:
+            alloc, pc, store, publish = _tiered_cache(
+                num_blocks=4, host_mb=40 / 2**20, nvme_path=nvme,
+                nvme_max_mb=150 / 2**20)
+            toksA = np.arange(12, dtype=np.int32)
+            publish(toksA, 1)
+            pc.evict(3)                   # A: 2 entries NVMe + 1 host
+            assert store.report()["nvme_entries"] == 2
+            publish(np.arange(100, 112, dtype=np.int32), 2)  # B fills pool
+            assert alloc.free_blocks == 1
+            # acquire A: deficit eviction demotes B -> host spill -> NVMe
+            # over cap -> sweep; A's batched entries must survive it
+            blocks, n = pc.acquire(toksA)
+            assert n >= 8                 # the pinned chain promoted
+            recs = pc.drain_promotes()
+            for r in recs:
+                r.fetch.wait()
+                r.fetch.release()
+                store.discard(r.key)
+            pc.mark_uploaded(recs)
+            if blocks:
+                alloc.free(blocks)
+            pc.clear()
+            assert not alloc.leaked_blocks()
+            assert store.pool.report()["outstanding"] == 0
+            store.close()
+
+    def test_acquire_chain_uses_one_ticket(self):
+        """End-to-end through PrefixCache.acquire: a 2-block demoted NVMe
+        chain promotes through ONE batched read, promote_ms semantics
+        unchanged (each record still carries its own fetch + t_start)."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as nvme:
+            alloc, pc, store, publish = _tiered_cache(
+                host_mb=40 / 2**20, nvme_path=nvme)
+            toks = np.arange(12, dtype=np.int32)
+            publish(toks, 9)
+            pc.evict(3)
+            # _spill keeps one entry host-resident; the older two hit NVMe
+            assert store.report()["nvme_entries"] == 2
+            singles = []
+            orig_one = store.swapper.swap_in_start
+            store.swapper.swap_in_start = \
+                lambda n: singles.append(n) or orig_one(n)
+            blocks, n = pc.acquire(toks)
+            assert n == 12
+            recs = pc.drain_promotes()
+            assert len(recs) == 3
+            assert store.counters["batched_reads"] == 1 and not singles
+            for r in recs:
+                assert r.fetch.t_start > 0     # promote_ms anchor intact
+                assert np.array_equal(r.fetch.wait()["k"],
+                                      _payload(9)["k"])
+                r.fetch.release()
+                store.discard(r.key)
+            pc.mark_uploaded(recs)
+            alloc.free(blocks)
+            pc.clear()
+            assert not alloc.leaked_blocks() and store.entries() == 0
+            assert store.pool.report()["outstanding"] == 0
+            store.close()
+
+
 # ---------------------------------------------------------------------------
 # tiered PrefixCache semantics (fake extract: no device in the loop)
 # ---------------------------------------------------------------------------

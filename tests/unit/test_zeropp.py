@@ -159,6 +159,215 @@ def test_mics_mesh_validation(eight_devices):
             "mesh": {"fsdp": 4, "dp": 2}, "steps_per_print": 100})
 
 
+# ---------------------------------------------------------------------------
+# the zero_pp config block (qwZ/hpZ/qgZ independently toggleable, bits,
+# block size, cross-slice-only) and its wiring into the plan
+# ---------------------------------------------------------------------------
+
+def test_zero_pp_block_builds_plan(eight_devices):
+    """The validated block spelling engages the explicit region with the
+    configured bits/block size; enabled-with-no-features is the dense
+    baseline plan (still explicit, still logged, nothing quantized)."""
+    eng = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config=make_config(3, {"fsdp": 4, "dp": 2},
+                           {"zero_pp": {"enabled": True, "qwz": True,
+                                        "qgz": True, "weight_bits": 4,
+                                        "grad_bits": 8,
+                                        "block_size": 512}}))[0]
+    f = eng._zpp.features
+    assert f["qwz"] and f["qgz"] and not f["hpz"]
+    assert f["weight_bits"] == 4 and f["grad_bits"] == 8
+    assert f["block_size"] == 512
+    dense = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config=make_config(3, {"fsdp": 4, "dp": 2},
+                           {"zero_pp": {"enabled": True}}))[0]
+    assert dense._zpp is not None
+    assert not any(dense._zpp.features[k] for k in ("qwz", "qgz", "hpz"))
+    got = run_steps(dense, 2)
+    assert got[-1] < got[0]
+
+
+def test_zero_pp_legacy_knobs_fold_into_block():
+    from deepspeed_tpu.config import DeepSpeedTpuConfig
+
+    cfg = DeepSpeedTpuConfig(
+        train_micro_batch_size_per_gpu=1,
+        zero_optimization={"stage": 3, "zero_quantized_weights": True,
+                           "zero_hpz_partition_size": 2})
+    zpp = cfg.zero_optimization.zero_pp
+    assert zpp.enabled and zpp.qwz and zpp.hpz and not zpp.qgz
+    assert zpp.hpz_partition_size == 2
+
+
+def test_zero_pp_conflicting_spellings_rejected():
+    from deepspeed_tpu.config import DeepSpeedTpuConfig
+
+    with pytest.raises(Exception, match="one spelling"):
+        DeepSpeedTpuConfig(
+            train_micro_batch_size_per_gpu=1,
+            zero_optimization={"stage": 3,
+                               "zero_quantized_gradients": True,
+                               "zero_pp": {"enabled": True, "qwz": True}})
+
+
+def test_zero_pp_validation():
+    from deepspeed_tpu.config.config import ZeroPPConfig
+
+    with pytest.raises(Exception, match="weight_bits"):
+        ZeroPPConfig(weight_bits=5)
+    with pytest.raises(Exception, match="grad_bits"):
+        ZeroPPConfig(grad_bits=16)
+    with pytest.raises(Exception, match="block_size"):
+        ZeroPPConfig(block_size=0)
+
+
+def test_two_hop_qgz_loss_parity_and_layout(eight_devices):
+    """qgZ over a simulated 4x2 sliced mesh: intra-slice bf16 +
+    inter-slice quantized matches the dense baseline, and the gradients
+    land in the SAME shard layout (params converge identically enough to
+    keep training)."""
+    mesh = {"fsdp": 8}
+    base = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                         config=make_config(3, mesh))[0]
+    two = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config=make_config(3, mesh,
+                           {"zero_pp": {"enabled": True, "qgz": True,
+                                        "slice_size": 2}}))[0]
+    assert two._zpp.features["two_hop"]
+    ref = run_steps(base, 4)
+    got = run_steps(two, 4)
+    assert got[-1] < got[0]
+    np.testing.assert_allclose(got, ref, rtol=0.05)
+
+
+def test_qwz_cross_slice_only_two_hop_gather(eight_devices):
+    """qwZ with cross_slice_only on a simulated 4x2 sliced mesh: only the
+    DCN hop of the param gather quantizes (int4), the ICI hop stays
+    dense — training still tracks the dense baseline."""
+    mesh = {"fsdp": 8}
+    base = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                         config=make_config(3, mesh))[0]
+    eng = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config=make_config(3, mesh,
+                           {"zero_pp": {"enabled": True, "qwz": True,
+                                        "weight_bits": 4, "slice_size": 2,
+                                        "cross_slice_only": True}}))[0]
+    assert eng._zpp.features["cross_slice_only"]
+    ref = run_steps(base, 4)
+    got = run_steps(eng, 4)
+    assert got[-1] < got[0]
+    np.testing.assert_allclose(got, ref, rtol=0.05)
+
+
+def test_slice_size_must_tile_the_axis(eight_devices):
+    """An explicit slice_size that cannot tile the fsdp axis is a LOUD
+    error — clamping would silently disable the two-hop split."""
+    for bad in (3, 16):
+        with pytest.raises(ValueError, match="slice_size"):
+            ds.initialize(
+                model=TransformerLM(get_preset("tiny")),
+                config=make_config(3, {"fsdp": 8},
+                                   {"zero_pp": {"enabled": True,
+                                                "qgz": True,
+                                                "slice_size": bad}}))
+
+
+def test_hpz_single_slice_graceful_fallback(eight_devices):
+    """hpz=True with a slice-local default partition on a single-slice
+    mesh: the secondary would coincide with the primary — the plan must
+    disable it (fall back), not crash or build a pointless copy."""
+    eng = ds.initialize(
+        model=TransformerLM(get_preset("tiny")),
+        config=make_config(3, {"fsdp": 8},
+                           {"zero_pp": {"enabled": True, "hpz": True}}))[0]
+    assert eng._zpp is not None and not eng._zpp.uses_secondary
+    assert not eng._zpp.features["hpz"]
+
+
+def test_quant_instruments_in_registry(eight_devices):
+    """train/quant_comm_ms + the qwZ/qgZ quant-error gauges land in the
+    observability registry and carry real samples after a print-cadence
+    step."""
+    from deepspeed_tpu.observability import get_registry
+
+    cfg = make_config(3, {"fsdp": 4, "dp": 2},
+                      {"zero_pp": {"enabled": True, "qwz": True,
+                                   "qgz": True,
+                                   "hpz": True, "hpz_partition_size": 2}})
+    cfg["steps_per_print"] = 1
+    cfg["observability"] = {"enabled": True}
+    eng = ds.initialize(model=TransformerLM(get_preset("tiny")),
+                        config=cfg)[0]
+    run_steps(eng, 2)
+    names = {f.name for f in get_registry().collect()}
+    for want in ("train/quant_comm_ms", "train/qwz_quant_error",
+                 "train/qgz_quant_error"):
+        assert want in names, want
+    with jax.sharding.set_mesh(eng.mesh):
+        err = eng._zpp.quant_error_fns["qwz"](eng.params)
+    assert 0.0 < float(err) < 0.2   # int8 blockwise error is small, not 0
+
+
+def test_int4_weight_gather_on_the_wire(eight_devices):
+    """weight_bits=4: the compiled step still carries s8 all-gather
+    payloads (packed nibbles ride int8 lanes) at HALF the int8 element
+    count — the 4x-over-bf16 wire saving qwZ int4 claims."""
+    import re
+
+    def lowered(bits):
+        eng = ds.initialize(
+            model=TransformerLM(get_preset("tiny")),
+            config=make_config(3, {"fsdp": 8},
+                               {"zero_pp": {"enabled": True, "qwz": True,
+                                            "weight_bits": bits}}))[0]
+        batch = eng._put_batch(fixed_batch(2 * eng.topology.dp_world_size))
+        with jax.sharding.set_mesh(eng.mesh):
+            return eng._fwd_bwd.lower(
+                eng.params, batch,
+                eng.scaler_state["scale"]).compile().as_text()
+
+    def s8_gather_elems(hlo):
+        total = 0
+        for line in hlo.splitlines():
+            if "all-gather" not in line:
+                continue
+            m = re.search(r"= s8\[([0-9,]+)\]", line)
+            if m:
+                import numpy as _np
+
+                total += int(_np.prod([int(v) for v in
+                                       m.group(1).split(",")]))
+        return total
+
+    e8 = s8_gather_elems(lowered(8))
+    e4 = s8_gather_elems(lowered(4))
+    assert e8 > 0 and e4 > 0, "no s8 all-gather payload in HLO"
+    assert e4 <= e8 // 2 + 8, (e4, e8)   # packed nibbles: half the bytes
+
+
+# ---------------------------------------------------------------------------
+# drill wrappers (slow; tools/comm_drill.py is the invariant authority)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.zpp
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["bytes", "parity", "two-hop"])
+def test_comm_drill(scenario, eight_devices):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), "..", "..", "tools"))
+    from comm_drill import run_scenario
+
+    verdict = run_scenario(scenario)
+    assert verdict["ok"], verdict
+
+
 def test_zero3_schedule_carries_gather_and_scatter(eight_devices):
     """Round-2 weak #3 (partial): the compiled ZeRO-3 step must contain the
     parameter all-gathers and gradient reduce-scatters that replace the

@@ -42,6 +42,11 @@ TRACKED = {
     "bench_infer": [("prefill_tokens_per_sec", "higher"),
                     ("decode.*.tokens_per_sec", "higher")],
     "bench_capacity": [("best.params_b", "higher")],
+    # ZeRO++ quantized collectives (bench.py --zero-pp): comm-volume
+    # reduction on the quantized ops and the quantized run's throughput
+    "bench_zero_pp": [("all_gather_reduction", "higher"),
+                      ("reduce_scatter_reduction", "higher"),
+                      ("quantized.tokens_per_sec", "higher")],
 }
 
 
