@@ -210,6 +210,43 @@ def main() -> None:
     _ledger(result, "bench")
 
 
+def bench_scaling():
+    """The ``--scaling`` mode: measured multi-chip scaling curves.
+
+    Parent process re-execs itself onto the forced-8-virtual-device CPU mesh
+    (the ``--zero-pp`` subprocess trick — a single chip cannot host an fsdp
+    axis, and the byte counters are exact there); the child runs the sweep
+    (world {1,2,4,8} × mesh shape {dp, fsdp, fsdp_qz, tp, pp×fsdp×tp,
+    dp×sp, dp×ep×sp}), prints the curves as one JSON line, and appends a
+    ``bench_scaling`` ledger entry that ``tools/bench_trend.py`` gates and
+    the mesh cost model calibrates from. Set ``DSTPU_DRYRUN_TPU=1`` to run
+    on real devices instead (same sweep, real ICI numbers)."""
+    import os
+
+    if (os.environ.get("DSTPU_SCALING_CHILD") != "1"
+            and os.environ.get("DSTPU_DRYRUN_TPU") != "1"):
+        import subprocess
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": os.environ.get("XLA_FLAGS", "")
+               + " --xla_force_host_platform_device_count=8",
+               "DSTPU_SCALING_CHILD": "1"}
+        r = subprocess.run([sys.executable, __file__, "--scaling"], env=env,
+                           timeout=3600)
+        return r.returncode
+    import jax
+
+    if os.environ.get("DSTPU_DRYRUN_TPU") != "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.autotuning.scaling import run_sweep
+
+    res = run_sweep()
+    print(json.dumps(res))
+    _ledger(res, "bench_scaling")
+    return 0 if any(res["curves"].values()) else 1
+
+
 def bench_zero_pp():
     """The ``zero_pp`` bench section: baseline-vs-quantized comm bytes and
     step time through ``tools/comm_drill.measure_pair`` (qwZ int4 weight
@@ -329,7 +366,9 @@ def _latest_capacity_artifact():
 
 
 if __name__ == "__main__":
-    if "--zero-pp" in sys.argv:
+    if "--scaling" in sys.argv:
+        sys.exit(bench_scaling())
+    elif "--zero-pp" in sys.argv:
         import json as _json
 
         _res = bench_zero_pp()

@@ -36,8 +36,17 @@ class MeshConfig(DSTpuConfigModel):
 
     Axis order (outer→inner) is chosen so the fastest-varying axes sit on ICI:
     pp (DCN-friendly, outermost) → dp → fsdp → ep → sp → tp (innermost, ICI).
+
+    ``"mesh": "auto"`` (or ``{"auto": true}``) asks for the measured-best
+    shape instead of explicit sizes: ``build_mesh`` consults the mesh
+    autotuner's winner cache keyed (model signature, world size, device
+    kind), falling back to the cost model's top-ranked legal factorization
+    (``parallel/cost_model.py``) when nothing was measured yet. The
+    ``autotuning`` config section points at the cache and sizes the search.
     """
 
+    # resolve axis sizes from the autotuner winner cache / cost model
+    auto: bool = False
     pp: int = 1
     dp: Union[int, Literal["auto"]] = AUTO
     fsdp: int = 1
@@ -46,6 +55,24 @@ class MeshConfig(DSTpuConfigModel):
     tp: int = 1
     # number of slices connected over DCN; 1 = single slice (all-ICI)
     num_slices: int = 1
+
+    @model_validator(mode="after")
+    def _check_auto(self):
+        explicit = [f for f in ("pp", "fsdp", "ep", "sp", "tp")
+                    if getattr(self, f) != 1]
+        if self.auto and (explicit or (self.dp != AUTO
+                                       and "dp" in self.model_fields_set)):
+            raise ValueError(
+                "mesh: 'auto' and explicit axis sizes are mutually "
+                f"exclusive (got explicit {explicit or ['dp']}) — drop the "
+                "sizes or the auto flag")
+        if self.auto and self.num_slices > 1:
+            raise ValueError(
+                "mesh: 'auto' does not support multi-slice (num_slices > 1) "
+                "topologies yet — the winner cache and cost-model fallback "
+                "resolve flat axis sizes and would silently drop the DCN "
+                "slice factoring; set the mesh axes explicitly")
+        return self
 
     def resolved_dp(self, n_devices: int) -> int:
         fixed = self.pp * self.fsdp * self.ep * self.sp * self.tp
@@ -946,6 +973,41 @@ class OffloadConfig(DSTpuConfigModel):
     aio: AioConfig = Field(default_factory=AioConfig)
 
 
+class AutotuningConfig(DSTpuConfigModel):
+    """``autotuning`` section (reference: ``deepspeed/autotuning/config.py``
+    ``DeepSpeedAutotuningConfig``, reduced to the knobs that exist here).
+
+    Governs the mesh axis of the tuner and the ``mesh: "auto"`` resolution
+    path: ``winner_cache`` is the measured-best store keyed (model
+    signature, world size, device kind); ``top_k`` is how many cost-model-
+    ranked shapes an ``Autotuner`` built over this config actually measures
+    (its ``mesh_top_k``/``steps``/axis defaults come from here when the
+    engine config carries an ``autotuning`` block); ``measure_steps`` the
+    timed steps per trial. Engine-init resolution on a cache miss always
+    falls back to the cost-model prediction, never to an implicit
+    multi-minute measurement inside ``initialize()``."""
+
+    top_k: int = 2
+    measure_steps: int = 3
+    winner_cache: str = ""   # "" = $DSTPU_MESH_CACHE or <tmpdir> default
+    # mesh-axis candidates the tuner enumerates over (subset of MESH_AXES);
+    # pp is included by default — trials carry a pipeline config
+    mesh_axes: List[str] = Field(
+        default_factory=lambda: ["pp", "dp", "fsdp", "ep", "sp", "tp"])
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.top_k < 1:
+            raise ValueError("autotuning.top_k must be >= 1")
+        if self.measure_steps < 1:
+            raise ValueError("autotuning.measure_steps must be >= 1")
+        bad = [a for a in self.mesh_axes
+               if a not in ("pp", "dp", "fsdp", "ep", "sp", "tp")]
+        if bad:
+            raise ValueError(f"autotuning.mesh_axes: unknown axes {bad}")
+        return self
+
+
 class ResilienceConfig(DSTpuConfigModel):
     """``resilience`` section: the closed-loop fault-tolerance layer
     (``deepspeed_tpu/resilience``) — step guard, retries, checkpoint
@@ -992,6 +1054,7 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     moe: MoEConfig = Field(default_factory=MoEConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     offload: OffloadConfig = Field(default_factory=OffloadConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     serving: ServingConfig = Field(default_factory=ServingConfig)
@@ -1024,6 +1087,8 @@ class DeepSpeedTpuConfig(DSTpuConfigModel):
     @classmethod
     def _legacy_keys(cls, values):
         if isinstance(values, dict):
+            if values.get("mesh") == AUTO:  # "mesh": "auto" spelling
+                values["mesh"] = {"auto": True}
             if "tensorboard" in values:  # old flat monitor keys
                 values.setdefault("monitor_config", {})["tensorboard"] = values.pop("tensorboard")
             if "csv_monitor" in values:

@@ -5,3 +5,13 @@ from deepspeed_tpu.parallel.topology import (  # noqa: F401
     build_mesh,
     single_device_topology,
 )
+from deepspeed_tpu.parallel.cost_model import (  # noqa: F401
+    CostModel,
+    LinkBandwidths,
+    ModelProfile,
+    collective_volumes,
+    enumerate_meshes,
+    fit_bandwidths,
+    model_signature,
+    rank_meshes,
+)

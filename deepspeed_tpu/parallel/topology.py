@@ -76,12 +76,21 @@ class Topology:
 
 def build_mesh(mesh_config: Optional[MeshConfig] = None,
                devices: Optional[Sequence] = None,
-               axis_sizes: Optional[Dict[str, int]] = None) -> Topology:
+               axis_sizes: Optional[Dict[str, int]] = None,
+               model_profile=None,
+               winner_cache: Optional[str] = None,
+               zero_stage: int = 0, micro_batch: int = 1) -> Topology:
     """Construct the global :class:`Topology`.
 
     ``axis_sizes`` overrides ``mesh_config`` for programmatic use. Multi-slice
     (DCN-connected) topologies use ``mesh_utils.create_hybrid_device_mesh`` so the
     outer axes (pp, dp) land on DCN and inner axes stay on ICI.
+
+    ``mesh_config.auto`` resolves the axis sizes from the mesh autotuner's
+    winner cache (measured-best shape for ``model_profile`` on this device
+    kind and world size), falling back to the cost model's top-ranked legal
+    factorization ranked under the caller's actual ``zero_stage`` /
+    ``micro_batch`` — see ``autotuning/mesh_store.py``.
     """
     import jax
     from jax.experimental import mesh_utils
@@ -90,6 +99,17 @@ def build_mesh(mesh_config: Optional[MeshConfig] = None,
     if devices is None:
         devices = jax.devices()
     n = len(devices)
+
+    if (axis_sizes is None and mesh_config is not None
+            and getattr(mesh_config, "auto", False)):
+        # lazy import: autotuning imports parallel for the cost model
+        from deepspeed_tpu.autotuning.mesh_store import (device_kind,
+                                                         resolve_auto_axis_sizes)
+
+        axis_sizes = resolve_auto_axis_sizes(
+            n, model_profile, winner_cache=winner_cache,
+            kind=device_kind(devices), zero_stage=zero_stage,
+            micro_batch=micro_batch)
 
     if axis_sizes is not None:
         sizes = {ax: int(axis_sizes.get(ax, 1)) for ax in MESH_AXES}

@@ -53,6 +53,17 @@ class DeepSpeedTpuEngine:
                  training_data=None, lr_scheduler=None, topology: Optional[Topology] = None,
                  collate_fn: Optional[Callable] = None, init_rng: Optional[jax.Array] = None):
         self.config = config
+        if topology is None and config.mesh.auto:
+            # mesh: "auto" — adopt the measured-best (or cost-model-ranked)
+            # shape for this model / world size / device kind
+            from deepspeed_tpu.parallel.cost_model import ModelProfile
+
+            mb = config.train_micro_batch_size_per_gpu
+            topology = build_mesh(
+                config.mesh, model_profile=ModelProfile.from_model(model),
+                winner_cache=config.autotuning.winner_cache or None,
+                zero_stage=int(config.zero_optimization.stage),
+                micro_batch=mb if isinstance(mb, int) else 1)
         self.topology = topology or build_mesh(config.mesh)
         self.mesh = self.topology.mesh
         if config.elasticity.enabled:

@@ -24,7 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 try:
     from bench_ledger import read_ledger
@@ -41,7 +41,20 @@ TRACKED = {
     "bench": [("value", "higher")],
     "bench_infer": [("prefill_tokens_per_sec", "higher"),
                     ("decode.*.tokens_per_sec", "higher")],
-    "bench_capacity": [("best.params_b", "higher")],
+    # capacity is a PER-(DEVICE, LADDER) series: the rung set runs on the
+    # dev CPU harness and on real chips with different achievable maxima,
+    # and a dev restatement must neither trip a phantom regression against
+    # a TPU/full-ladder figure nor mask a real one (the old flat
+    # best.params_b path was exactly that cross-series comparison)
+    "bench_capacity": [("by_device.*.*.params_b", "higher")],
+    # measured multi-chip scaling (bench.py --scaling): every
+    # (device kind, mesh shape, world size) config is its own trend
+    # series, like the decode.* occupancies — tokens/s/chip and parallel
+    # efficiency both gate, so a shape that keeps its throughput by
+    # silently losing efficiency (or vice versa) still trips the gate,
+    # while a CPU-harness run never gates against a TPU entry
+    "bench_scaling": [("curves.*.*.*.tokens_per_sec_per_chip", "higher"),
+                      ("curves.*.*.*.parallel_efficiency", "higher")],
     # ZeRO++ quantized collectives (bench.py --zero-pp): comm-volume
     # reduction on the quantized ops and the quantized run's throughput
     "bench_zero_pp": [("all_gather_reduction", "higher"),
