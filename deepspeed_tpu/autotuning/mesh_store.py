@@ -59,7 +59,8 @@ class WinnerStore:
         try:
             with open(self.path, "r", encoding="utf-8") as f:
                 data = json.load(f)
-            if isinstance(data, dict) and data.get("schema") == STORE_SCHEMA:
+            if isinstance(data, dict) and data.get("schema") == STORE_SCHEMA \
+                    and isinstance(data.get("winners"), dict):
                 return data
         except (OSError, json.JSONDecodeError):
             pass

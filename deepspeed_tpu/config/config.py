@@ -679,6 +679,66 @@ class RouterConfig(DSTpuConfigModel):
         return self
 
 
+class FleetConfig(DSTpuConfigModel):
+    """``serving.fleet``: elastic replica lifecycle above the router
+    (``deepspeed_tpu/serving/fleet.py``) — crash detection + respawn with
+    READY-gated readmission, queue/shed/retry-after-driven autoscaling
+    with hysteresis, and rolling weight swaps under a min-ready floor."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # a worker whose stats heartbeat is older than this is treated as hung
+    # and recovered like a death (thread-death detection is immediate)
+    heartbeat_timeout_s: float = 10.0
+    # readiness probe for a respawned/new replica before readmission: a
+    # tiny generate must complete within this budget
+    probe_timeout_s: float = 120.0
+    probe_max_new_tokens: int = 2
+    # respawn back-off: base * 2^attempt, capped; attempts above
+    # max_respawns leave the replica out (the flight recorder has the why)
+    respawn_backoff_s: float = 0.5
+    max_respawns: int = 3
+    # autoscaling signals with hysteresis: scale up after scale_up_polls
+    # consecutive polls with pool queue depth > scale_up_queue_per_replica
+    # x ready replicas (or any shed/reject activity in the poll window);
+    # scale down after scale_down_idle_polls consecutive idle polls
+    scale_up_queue_per_replica: float = 4.0
+    # pool-max current_retry_after() watermark that also counts as
+    # pressure (the shed hint an idle manager emits is retry_after_s,
+    # default 1s; a saturated one up to ~4x that)
+    scale_up_retry_after_s: float = 2.0
+    scale_up_polls: int = 2
+    scale_down_idle_polls: int = 6
+    # rolling swap: never drop below this many READY replicas while one
+    # replica at a time drains, reloads weights, and rejoins
+    min_ready_floor: int = 1
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError("serving.fleet: need 1 <= min_replicas <= "
+                             "max_replicas")
+        if self.heartbeat_timeout_s <= 0 or self.probe_timeout_s <= 0:
+            raise ValueError("serving.fleet: heartbeat_timeout_s and "
+                             "probe_timeout_s must be > 0")
+        if self.respawn_backoff_s < 0 or self.max_respawns < 1:
+            raise ValueError("serving.fleet: respawn_backoff_s must be "
+                             ">= 0 and max_respawns >= 1")
+        if self.scale_up_polls < 1 or self.scale_down_idle_polls < 1:
+            raise ValueError("serving.fleet: scale_up_polls and "
+                             "scale_down_idle_polls must be >= 1")
+        if self.scale_up_queue_per_replica < 0:
+            raise ValueError("serving.fleet.scale_up_queue_per_replica "
+                             "must be >= 0")
+        if self.min_ready_floor < 1:
+            raise ValueError("serving.fleet.min_ready_floor must be >= 1")
+        if self.probe_max_new_tokens < 1:
+            raise ValueError("serving.fleet.probe_max_new_tokens must be "
+                             ">= 1")
+        return self
+
+
 class ServingConfig(DSTpuConfigModel):
     """``serving`` section: the request-lifecycle layer above
     ``InferenceEngineV2`` (``deepspeed_tpu/serving``) — bounded admission,
@@ -723,6 +783,7 @@ class ServingConfig(DSTpuConfigModel):
     max_done_history: int = 65536
     frontend: FrontendConfig = Field(default_factory=FrontendConfig)
     router: RouterConfig = Field(default_factory=RouterConfig)
+    fleet: FleetConfig = Field(default_factory=FleetConfig)
 
     @model_validator(mode="after")
     def _check(self):

@@ -424,6 +424,32 @@ class AsyncTensorSwapper:
         return t
 
     # ------------------------------------------------------------------
+    def adopt_meta(self, name: str, shape, dtype) -> None:
+        """Register shape/dtype for a swap file written by ANOTHER swapper
+        (typically a previous process — metadata lives in memory, files on
+        disk). The warm-start cache persists each leaf's meta in its
+        manifest and adopts it here before ``swap_in_start_many``, so a
+        respawned replica can stream weights it never wrote. Raises
+        :class:`FileNotFoundError` when the backing file is missing or
+        shorter than the metadata claims — a torn cache must surface at
+        adopt time, not as a short read mid-ticket."""
+        shape = tuple(int(s) for s in shape)
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        path = self._path(name).decode()
+        try:
+            have = os.path.getsize(path)
+        except OSError as e:
+            raise FileNotFoundError(f"swap file for {name!r} missing: "
+                                    f"{e}") from e
+        if have < nbytes:
+            raise FileNotFoundError(
+                f"swap file for {name!r} torn: {have} < {nbytes} bytes")
+        self._meta[name] = (shape, dtype)
+
+    def has_meta(self, name: str) -> bool:
+        return name in self._meta
+
     def swap_out(self, name: str, array: np.ndarray) -> SwapTicket:
         """Copy ``array`` into a pooled buffer and submit an async (chunked)
         write. The caller's array is free for reuse immediately; the pooled

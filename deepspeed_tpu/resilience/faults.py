@@ -33,6 +33,16 @@ Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
   ``decode_nan`` poisons a step's returned logits so the batcher's failure
   window and degraded mode are exercised, and ``shed_storm`` forces the
   watermark-shedding path for ``times`` consecutive serving steps.
+* replica-lifecycle sites (``serving/router.py`` + ``serving/fleet.py``,
+  drilled by ``tools/elastic_drill.py``): ``replica_crash`` raises
+  :class:`InjectedCrash` at the top of a replica worker loop — OUTSIDE the
+  batcher step's own exception absorption — so the worker thread actually
+  dies and the :class:`FleetController` death-detection path runs
+  (``site`` optionally pins the crash to one replica name; ``hard``
+  hard-exits, simulating host loss); ``slow_start`` sleeps ``delay_s`` at
+  replica startup (cold-start / readiness-probe timeout drills);
+  ``weight_load_io_error`` raises :class:`InjectedIOError` in the warm
+  weight-load path so the cold fallback is exercised.
 """
 
 from __future__ import annotations
@@ -84,7 +94,9 @@ class FaultSpec:
     KINDS = ("crash", "nan_grads", "slow_collective", "failed_collective",
              "torn_checkpoint", "io_error",
              # serving sites (ContinuousBatcher hooks)
-             "slow_decode", "decode_nan", "shed_storm", "cache_io_error")
+             "slow_decode", "decode_nan", "shed_storm", "cache_io_error",
+             # replica-lifecycle sites (Replica/FleetController hooks)
+             "replica_crash", "slow_start", "weight_load_io_error")
 
     def __post_init__(self):
         if self.kind not in self.KINDS:
@@ -232,6 +244,44 @@ class FaultInjector:
                 self._record(spec, "serving:shed")
                 return True
         return False
+
+    # ---- replica-lifecycle faults -----------------------------------------
+    def on_replica_loop(self, name: str) -> None:
+        """Hook at the top of a :class:`Replica` worker iteration, BEFORE
+        the batcher-step try/except — an injected ``replica_crash`` must
+        escape the loop and kill the worker thread (that absorption
+        boundary exists for step bugs, not for host loss). ``site`` pins
+        the crash to one replica name; None kills whichever replica's
+        worker fires first."""
+        for spec in self.faults:
+            if spec.kind == "replica_crash" and spec.site in (None, name) \
+                    and self._take(spec):
+                self._record(spec, f"replica:{name}")
+                if spec.hard:
+                    os._exit(spec.exit_code)
+                raise InjectedCrash(f"injected replica crash ({name})")
+
+    def on_replica_start(self, name: str) -> None:
+        """Hook at replica worker startup: ``slow_start`` sleeps
+        ``delay_s`` (cold-start and readiness-probe-timeout drills).
+        ``site`` pins the stall to one replica name."""
+        for spec in self.faults:
+            if spec.kind == "slow_start" and spec.site in (None, name) \
+                    and self._take(spec):
+                self._record(spec, f"replica_start:{name}")
+                time.sleep(spec.delay_s)
+
+    def on_weight_load(self, what: str = "warm") -> None:
+        """Hook in the warm-start weight path (``what``: ``warm`` for the
+        AIO-streamed read, ``publish`` for the cache write): a
+        ``weight_load_io_error`` spec raises so callers must fall back to
+        the cold path rather than crash the respawn."""
+        for spec in self.faults:
+            if spec.kind == "weight_load_io_error" \
+                    and spec.site in (None, what) and self._take(spec):
+                self._record(spec, f"weight_load:{what}")
+                raise InjectedIOError(
+                    f"injected weight-load IO failure ({what})")
 
     def maybe_tear_checkpoint(self, tag_dir: str, step: int) -> bool:
         """After a save: damage the newest tag so verification must reject it.

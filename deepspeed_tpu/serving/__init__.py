@@ -34,16 +34,30 @@ The network layer above the batcher (the MII/FastGen product-layer shape):
 * :mod:`~deepspeed_tpu.serving.client` — :class:`GenerateClient`: stdlib
   reference client honoring the 429/``Retry-After`` backpressure contract.
 
+The elastic layer above the router (replica lifecycle):
+
+* :mod:`~deepspeed_tpu.serving.fleet` — :class:`FleetController`: crash
+  detection + fail-over + respawn/readmit, queue/shed/retry-after-driven
+  autoscaling with hysteresis, and rolling weight swaps that never drop
+  below a min-READY floor;
+* :mod:`~deepspeed_tpu.serving.coldstart` — :class:`WarmStartCache`:
+  AIO-streamed weight persistence plus reused compiled executables so a
+  respawn is a warm start, keyed like the mesh autotuner's WinnerStore.
+
 Chaos-drilled by ``tools/serve_drill.py`` (deadline-storm,
-shed-under-KV-pressure, SIGTERM-drain, frontend-storm) through the same
-deterministic fault injector that drills training (``resilience/faults.py``
-serving sites: ``slow_decode``, ``decode_nan``, ``shed_storm``,
-``cache_io_error``).
+shed-under-KV-pressure, SIGTERM-drain, frontend-storm) and
+``tools/elastic_drill.py`` (replica-crash-mid-storm, burst-autoscale,
+rolling-swap, cold-start-bench) through the same deterministic fault
+injector that drills training (``resilience/faults.py`` serving sites:
+``slow_decode``, ``decode_nan``, ``shed_storm``, ``cache_io_error``,
+``replica_crash``, ``slow_start``, ``weight_load_io_error``).
 """
 
 from deepspeed_tpu.serving.batcher import (DEGRADED, DRAINING, READY,
                                            STARTING, ContinuousBatcher)
 from deepspeed_tpu.serving.client import FrontendError, GenerateClient
+from deepspeed_tpu.serving.coldstart import WarmStartCache, warm_key
+from deepspeed_tpu.serving.fleet import FleetController
 from deepspeed_tpu.serving.frontend import ServingFrontend
 from deepspeed_tpu.serving.manager import RequestManager
 from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
@@ -55,7 +69,7 @@ from deepspeed_tpu.serving.router import Replica, ReplicaRouter
 __all__ = [
     "CANCELLED", "COMPLETED", "DECODING", "DEGRADED", "DRAINING", "EXPIRED",
     "PREFILLING", "QUEUED", "READY", "SHED", "STARTING", "TERMINAL_STATES",
-    "ContinuousBatcher", "FrontendError", "GenerateClient", "Replica",
-    "ReplicaRouter", "RequestManager", "ServeRequest", "ServingFrontend",
-    "ShedError",
+    "ContinuousBatcher", "FleetController", "FrontendError", "GenerateClient",
+    "Replica", "ReplicaRouter", "RequestManager", "ServeRequest",
+    "ServingFrontend", "ShedError", "WarmStartCache", "warm_key",
 ]

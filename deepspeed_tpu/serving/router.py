@@ -27,26 +27,44 @@ SIGTERM parity with the single-replica batcher: ``install_signal_handlers``
 maps SIGTERM onto a drain (of one named replica or the whole pool) with
 migration, run from a helper thread so the signal handler itself stays
 async-safe.
+
+Elastic lifecycle (the :class:`~deepspeed_tpu.serving.fleet.FleetController`
+contract): every :class:`Replica` carries a process-unique ``incarnation``
+token, and routes remember the incarnation that minted their uid. A crashed
+replica's queued requests are captured post-mortem (:meth:`Replica.
+capture_dead`) and re-homed by :meth:`ReplicaRouter.fail_over`; the respawn
+rejoins through :meth:`ReplicaRouter.readmit`, which retires the dead
+incarnation's terminal ledger so pool-level ``resolve()`` keeps answering
+for uids minted before the crash — a respawned replica numbers its uids
+from 0 again, and without the incarnation check uid 5 of the NEW batcher
+would answer for uid 5 of the dead one.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import signal
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
+from deepspeed_tpu.observability.trace import flight_dump
+from deepspeed_tpu.resilience.faults import get_injector
 from deepspeed_tpu.serving.batcher import DEGRADED, DRAINING, READY
 from deepspeed_tpu.serving.protocol import terminal_record
 from deepspeed_tpu.serving.request import CANCELLED, ServeRequest, ShedError
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["Replica", "ReplicaRouter"]
+
+# process-unique replica incarnation tokens: a respawn under the SAME name
+# must never be mistaken for the batcher that died (uids restart from 0)
+_INCARNATIONS = itertools.count()
 
 
 
@@ -72,6 +90,8 @@ class Replica:
         self.submit_timeout_s = float(submit_timeout_s)
         self.inbox: "queue.Queue" = queue.Queue()
         self.paused = False            # test hook: commands yes, steps no
+        self.incarnation = next(_INCARNATIONS)
+        self.crash_error: Optional[BaseException] = None
         self._subs: Dict[int, _Sub] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -79,7 +99,9 @@ class Replica:
         # dict REPLACED atomically each step, never mutated in place
         self.stats: Dict = {"health": batcher.health, "queue_depth": 0,
                             "active": 0, "projected_kv": 0.0,
-                            "kv_occupancy": 0.0, "drained": False}
+                            "kv_occupancy": 0.0, "drained": False,
+                            "beat": time.monotonic(), "retry_after": 0.0,
+                            "sheds": 0}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -91,6 +113,19 @@ class Replica:
                 daemon=True)
             self._thread.start()
         return self
+
+    def interrupt(self, timeout_s: float = 5.0) -> bool:
+        """Ask the worker to stop and wait briefly; True once it is dead.
+        The hung-heartbeat recovery path: a worker stuck inside a step
+        cannot be preempted from outside, so the controller interrupts,
+        and only proceeds to :meth:`capture_dead` when the thread actually
+        exited (False = still wedged, retry next poll)."""
+        self._stop.set()
+        self.inbox.put(None)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        return not self.alive
 
     def close(self) -> None:
         """Idempotent: stop and join the worker, fail queued commands,
@@ -135,10 +170,15 @@ class Replica:
         return self.batcher.health
 
     @property
+    def alive(self) -> bool:
+        """Worker thread running — False for a crashed or closed replica."""
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
     def routable(self) -> bool:
         st = self.stats
-        return (self._thread is not None and self._thread.is_alive()
-                and st["health"] != DRAINING and not st["drained"])
+        return (self.alive and st["health"] != DRAINING
+                and not st["drained"])
 
     def load_score(self) -> float:
         """Lower = less loaded: queued + active requests, with projected
@@ -200,26 +240,86 @@ class Replica:
     def resolve(self, uid: int) -> Optional[str]:
         return self._command("resolve", uid)
 
+    def capture_dead(self) -> List[Tuple[ServeRequest,
+                                         Optional["queue.Queue"]]]:
+        """Post-mortem capture after the worker thread died (crash path).
+        Only legal on a DEAD replica — the batcher is single-threaded by
+        contract, and this walks it from the caller's thread. Fails any
+        commands stranded in the inbox, detaches the queued-but-unstarted
+        requests (with their event queues) for the router to re-home,
+        terminal-izes EVERYTHING still on the dead batcher as
+        ``replica_crash`` sheds (queued copies stay silent — the router
+        re-homes them; in-flight requests lost their KV with the worker,
+        so their subscribers get the shed END event), and tears the
+        batcher down. Every uid the dead replica ever admitted keeps
+        resolving terminal through its (soon retired) ledger."""
+        if self.alive:
+            raise RuntimeError(
+                f"replica {self.name} worker still alive — capture_dead "
+                f"is a post-mortem path (drain a live replica instead)")
+        while True:                    # unblock callers stuck on commands
+            try:
+                cmd = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            if cmd is not None:
+                cmd[2].set_exception(ShedError(
+                    "replica_unavailable", retryable=True,
+                    retry_after_s=1.0, detail=f"{self.name} crashed"))
+        m = self.batcher.manager
+        captured = []
+        for req in list(m.queue):
+            sub = self._subs.pop(req.uid, None)
+            captured.append((req, None if sub is None else sub.events))
+        for req in list(m.queue):
+            m.shed(req, "replica_crash")
+        for req in list(m.active.values()):
+            m.shed(req, "replica_crash")
+        for uid, sub in list(self._subs.items()):
+            req = m.result(uid)
+            if req is not None and req.done:
+                sub.events.put({"event": "end", "replica": self.name,
+                                **terminal_record(req)})
+        self._subs.clear()
+        self._update_stats()
+        self.batcher.close()
+        return captured
+
     # ------------------------------------------------------------------
     # worker loop (the only batcher-touching thread)
     # ------------------------------------------------------------------
     def _run(self) -> None:
-        self._update_stats()
-        while not self._stop.is_set():
-            m = self.batcher.manager
-            idle = (self.paused or self.batcher.drained
-                    or (not m.active and not m.queue))
-            self._drain_commands(block=idle)
-            if self._stop.is_set():
-                break
-            if not self.paused and not self.batcher.drained:
-                try:
-                    self.batcher.step()
-                except Exception as e:   # a step bug must not kill serving
-                    logger.warning(f"serving: replica {self.name} step "
-                                   f"raised {e!r}")
-            self._publish()
+        try:
+            get_injector().on_replica_start(self.name)
             self._update_stats()
+            while not self._stop.is_set():
+                # the crash site sits OUTSIDE the step try/except below:
+                # that absorption boundary exists for step bugs, and an
+                # injected replica_crash must actually kill the worker
+                get_injector().on_replica_loop(self.name)
+                m = self.batcher.manager
+                idle = (self.paused or self.batcher.drained
+                        or (not m.active and not m.queue))
+                self._drain_commands(block=idle)
+                if self._stop.is_set():
+                    break
+                if not self.paused and not self.batcher.drained:
+                    try:
+                        self.batcher.step()
+                    except Exception as e:  # step bug must not kill serving
+                        logger.warning(f"serving: replica {self.name} step "
+                                       f"raised {e!r}")
+                self._publish()
+                self._update_stats()
+        except Exception as e:         # worker death == replica crash
+            self.crash_error = e
+            logger.warning(f"serving: replica {self.name} worker died: "
+                           f"{e!r}")
+            flight_dump("replica_crash",
+                        extra={"replica": self.name,
+                               "incarnation": self.incarnation,
+                               "error": repr(e)},
+                        key=f"replica_crash:{self.name}:{self.incarnation}")
 
     def _drain_commands(self, block: bool) -> None:
         try:
@@ -307,14 +407,21 @@ class Replica:
             "kv_occupancy": b.kv_occupancy,
             "projected_kv": b._projected_blocks() / max(1, b.num_blocks),
             "drained": b.drained,
+            # autoscaler signals: heartbeat (stale beat = hung worker),
+            # the load-aware Retry-After watermark, and the cumulative
+            # shed+reject count (the controller differences it per poll)
+            "beat": time.monotonic(),
+            "retry_after": m.current_retry_after(),
+            "sheds": m.counters["shed"] + m.counters["rejected"],
         }
 
 
 class _Route:
-    __slots__ = ("replica", "uid", "events", "migrations")
+    __slots__ = ("replica", "inc", "uid", "events", "migrations")
 
-    def __init__(self, replica: str, uid: int, events):
+    def __init__(self, replica: str, inc: int, uid: int, events):
         self.replica = replica
+        self.inc = inc                 # incarnation that minted `uid`
         self.uid = uid
         self.events = events
         self.migrations = 0
@@ -344,33 +451,47 @@ class ReplicaRouter:
         # would silently no-op on it
         self._routes: Dict[int, _Route] = {}           #: guarded_by: _lock
         self._route_order: Deque[int] = deque()        #: guarded_by: _lock
-        #: guarded_by: _lock
-        self._by_loc: Dict[Tuple[str, int], int] = {}  # (replica, uid)→ruid
+        #: guarded_by: _lock — (replica, incarnation, uid) → ruid
+        self._by_loc: Dict[Tuple[str, int, int], int] = {}
         self._next_ruid = 0                            #: guarded_by: _lock
+        # terminal ledgers of retired incarnations (crashed / swapped-out
+        # replicas), bounded FIFO: pool-level resolve() keeps answering
+        # for uids minted before a respawn replaced their home
+        #: guarded_by: _lock
+        self._retired: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
+        self._max_retired = 16
         self._prev_sigterm = None
         self.counters: Dict[str, int] = {              #: guarded_by: _lock
             "routed": 0, "failover": 0, "rejected": 0, "migrated": 0,
-            "migration_failed": 0, "drains": 0,
+            "migration_failed": 0, "drains": 0, "crash_failovers": 0,
+            "readmits": 0,
         }
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
     def start(self) -> "ReplicaRouter":
-        for rep in self.replicas.values():
+        for rep in self._snapshot():
             rep.start()
         return self
 
     def close(self) -> None:
         self.restore_signal_handlers()
-        for rep in self.replicas.values():
+        for rep in self._snapshot():
             rep.close()
+
+    def _snapshot(self) -> List[Replica]:
+        """Consistent view of the pool: the replica dict mutates under
+        ``_lock`` (readmit/add/remove), so iteration must not walk it
+        live."""
+        with self._lock:
+            return list(self.replicas.values())
 
     @property
     def health(self) -> str:
         """Pool health for the shared ``/readyz``: ready while ANY replica
         can take traffic; draining only when the whole pool is going away."""
-        states = [r.stats["health"] for r in self.replicas.values()]
+        states = [r.stats["health"] for r in self._snapshot()]
         if READY in states:
             return READY
         if DEGRADED in states:
@@ -388,7 +509,7 @@ class ReplicaRouter:
         must get traffic to ever leave STARTING); DEGRADED ranks last (it
         runs on reduced capacity, so siblings absorb first); DRAINING is
         excluded entirely by ``routable``."""
-        cands = [r for r in self.replicas.values()
+        cands = [r for r in self._snapshot()
                  if r.name not in exclude and r.routable]
         return sorted(cands, key=lambda r: (
             1 if r.stats["health"] == DEGRADED else 0, r.load_score()))
@@ -426,7 +547,8 @@ class ReplicaRouter:
                 if _ruid is None:
                     ruid = self._next_ruid
                     self._next_ruid += 1
-                    self._routes[ruid] = _Route(rep.name, uid, events)
+                    self._routes[ruid] = _Route(rep.name, rep.incarnation,
+                                                uid, events)
                     self._route_order.append(ruid)
                     self.counters["routed"] += 1
                     self._evict_terminal_routes()
@@ -439,14 +561,17 @@ class ReplicaRouter:
                         # ledger, making the route eviction-eligible):
                         # re-insert under the SAME ruid so the client's
                         # uid keeps resolving through the migration
-                        route = _Route(rep.name, uid, events)
+                        route = _Route(rep.name, rep.incarnation, uid,
+                                       events)
                         self._routes[ruid] = route
                         self._route_order.append(ruid)
                     else:
-                        self._by_loc.pop((route.replica, route.uid), None)
+                        self._by_loc.pop(
+                            (route.replica, route.inc, route.uid), None)
                         route.replica, route.uid = rep.name, uid
+                        route.inc = rep.incarnation
                     route.migrations += 1
-                self._by_loc[(rep.name, uid)] = ruid
+                self._by_loc[(rep.name, rep.incarnation, uid)] = ruid
             return ruid
         with self._lock:
             self.counters["rejected"] += 1
@@ -458,39 +583,51 @@ class ReplicaRouter:
                         retry_after_s=max(hint, last.retry_after_s or 0.0),
                         detail=f"all {attempts} routable replicas refused")
 
-    def _route_loc(self, ruid: int) -> Optional[Tuple[str, int]]:
-        """Snapshot (replica, uid) under the lock: a migration rewrites
-        ``route.replica``/``route.uid`` as a pair under ``_lock``, so an
-        unlocked reader could see the OLD replica with the NEW uid (or
-        race the eviction sweep) and aim its command at the wrong
-        batcher."""
+    def _route_loc(self, ruid: int) -> Optional[Tuple[str, int, int]]:
+        """Snapshot (replica, incarnation, uid) under the lock: a
+        migration rewrites ``route.replica``/``route.inc``/``route.uid``
+        as a unit under ``_lock``, so an unlocked reader could see the OLD
+        replica with the NEW uid (or race the eviction sweep) and aim its
+        command at the wrong batcher."""
         with self._lock:
             route = self._routes.get(ruid)
             if route is None:
                 return None
-            return route.replica, route.uid
+            return route.replica, route.inc, route.uid
 
     def cancel(self, ruid: int) -> bool:
         loc = self._route_loc(ruid)
         if loc is None:
             return False
+        name, inc, uid = loc
+        rep = self.replicas.get(name)
+        if rep is None or rep.incarnation != inc:
+            return False     # home incarnation retired: already terminal
         try:
-            return self.replicas[loc[0]].cancel(loc[1])
+            return rep.cancel(uid)
         except ShedError:
             return False
 
     def resolve(self, ruid: int) -> Optional[str]:
         """Terminal/current state for a router uid — follows the route
-        through any migrations, so 'no admitted uid silently lost' is
-        checkable at the pool level exactly like at one replica."""
+        through any migrations AND through replica respawns, so 'no
+        admitted uid silently lost' is checkable at the pool level exactly
+        like at one replica. A route whose home incarnation was replaced
+        (crash respawn, rolling swap) answers from the retired ledger —
+        never from the new batcher, whose uids restart at 0."""
         loc = self._route_loc(ruid)
         if loc is None:
             return None
-        rep = self.replicas[loc[0]]
-        try:
-            return rep.resolve(loc[1])
-        except ShedError:
-            return rep.batcher.manager.resolve(loc[1])
+        name, inc, uid = loc
+        rep = self.replicas.get(name)
+        if rep is not None and rep.incarnation == inc:
+            try:
+                return rep.resolve(uid)
+            except ShedError:
+                return rep.batcher.manager.resolve(uid)
+        with self._lock:
+            mgr = self._retired.get((name, inc))
+        return None if mgr is None else mgr.resolve(uid)
 
     # ------------------------------------------------------------------
     # drain + migration
@@ -506,9 +643,39 @@ class ReplicaRouter:
         with self._lock:
             self.counters["drains"] += 1
         captured = rep.request_drain(reason)
+        migrated, failed = self._migrate(rep, captured)
+        logger.warning(f"serving: router drained {name} ({reason}); "
+                       f"migrated={migrated} failed={failed} "
+                       f"in_flight_left={rep.stats['active']}")
+        return {"replica": name, "captured": len(captured),
+                "migrated": migrated, "failed": failed}
+
+    def fail_over(self, name: str) -> Dict:
+        """Crash path: post-mortem capture of a DEAD replica's queued-but-
+        unstarted requests, re-homed onto siblings exactly like a drain
+        migration (same uid/priority/deadline/event-stream preservation).
+        In-flight requests died with their KV — their uids resolve as
+        ``replica_crash`` sheds, refused loudly, never lost silently."""
+        rep = self.replicas[name]
+        captured = rep.capture_dead()
+        migrated, failed = self._migrate(rep, captured)
+        with self._lock:
+            self.counters["crash_failovers"] += 1
+        logger.warning(f"serving: router failed over dead {name}; "
+                       f"migrated={migrated} failed={failed} "
+                       f"error={rep.crash_error!r}")
+        return {"replica": name, "captured": len(captured),
+                "migrated": migrated, "failed": failed}
+
+    def _migrate(self, rep: Replica, captured) -> Tuple[int, int]:
+        """Re-home captured (request, events) pairs onto siblings of
+        ``rep``. Each migrated request keeps its router uid, priority,
+        remaining deadline, and event stream. Returns (migrated, failed);
+        failures resolve as retryable sheds on the event stream."""
+        name = rep.name
         migrated = failed = 0
         for req, events in captured:
-            ruid = self._ruid_for(name, req.uid)
+            ruid = self._ruid_for(name, rep.incarnation, req.uid)
             remaining = (None if req.deadline is None
                          else req.deadline - self.clock())
             if remaining is not None and remaining <= 0:
@@ -562,15 +729,73 @@ class ReplicaRouter:
         with self._lock:
             self.counters["migrated"] += migrated
             self.counters["migration_failed"] += failed
-        logger.warning(f"serving: router drained {name} ({reason}); "
-                       f"migrated={migrated} failed={failed} "
-                       f"in_flight_left={rep.stats['active']}")
-        return {"replica": name, "captured": len(captured),
-                "migrated": migrated, "failed": failed}
+        return migrated, failed
 
-    def _ruid_for(self, replica: str, uid: int) -> Optional[int]:
+    def _ruid_for(self, replica: str, inc: int, uid: int) -> Optional[int]:
         with self._lock:
-            return self._by_loc.get((replica, uid))
+            return self._by_loc.get((replica, inc, uid))
+
+    # ------------------------------------------------------------------
+    # elastic membership (FleetController surface)
+    # ------------------------------------------------------------------
+    def readmit(self, name: str, replacement: Replica,
+                require_ready: bool = True) -> None:
+        """Swap a respawned ``replacement`` in for the retired incarnation
+        under ``name`` — the fix for the old permanent-exclusion bug (a
+        drained or dead replica could never rejoin the routing set).
+        READY-gated by default: the controller warms the replacement with
+        a probe first, so the pool never routes to a replica still
+        compiling. The old incarnation's terminal ledger is retired, not
+        dropped — pool-level ``resolve()`` keeps answering for its uids."""
+        if replacement.name != name:
+            raise ValueError(f"replacement is named {replacement.name!r}, "
+                             f"expected {name!r}")
+        if not replacement.alive:
+            raise RuntimeError(f"replica {name} replacement worker is not "
+                               f"running — start() it before readmit")
+        if require_ready and replacement.health != READY:
+            raise RuntimeError(
+                f"replica {name} replacement is {replacement.health!r}, "
+                f"not {READY!r} — probe it to READY before readmit")
+        with self._lock:
+            old = self.replicas.get(name)
+            if old is not None and old is not replacement:
+                self._retire_locked(old)
+            self.replicas[name] = replacement
+            self.counters["readmits"] += 1
+        logger.warning(f"serving: router readmitted {name} "
+                       f"(incarnation {replacement.incarnation})")
+
+    def add_replica(self, replica: Replica) -> None:
+        """Scale-up admission of a brand-new name (see :meth:`readmit`
+        for respawns under an existing name)."""
+        with self._lock:
+            if replica.name in self.replicas:
+                raise ValueError(f"replica {replica.name} already in the "
+                                 f"pool — use readmit() for a respawn")
+            self.replicas[replica.name] = replica
+
+    def remove_replica(self, name: str) -> Replica:
+        """Scale-down removal: only a non-routable (drained or dead)
+        replica may leave, and never the last one. Its terminal ledger is
+        retired so in-ledger uids keep resolving."""
+        with self._lock:
+            rep = self.replicas.get(name)
+            if rep is None:
+                raise KeyError(name)
+            if rep.routable:
+                raise RuntimeError(f"replica {name} is still routable — "
+                                   f"drain it before removal")
+            if len(self.replicas) == 1:
+                raise RuntimeError("cannot remove the last replica")
+            self._retire_locked(rep)
+            del self.replicas[name]
+        return rep
+
+    def _retire_locked(self, rep: Replica) -> None:  #: holds: _lock
+        self._retired[(rep.name, rep.incarnation)] = rep.batcher.manager
+        while len(self._retired) > self._max_retired:
+            self._retired.popitem(last=False)
 
     def _evict_terminal_routes(self) -> None:  #: holds: _lock
         """Called under ``self._lock``. Drops oldest routes past the
@@ -590,7 +815,10 @@ class ReplicaRouter:
                 self._route_order.popleft()
                 continue
             rep = self.replicas.get(route.replica)
-            if rep is not None:
+            if rep is not None and rep.incarnation == route.inc:
+                # a route whose home incarnation retired is terminal by
+                # construction (capture_dead/drain terminal-ized it) —
+                # only the still-current incarnation is probed for life
                 m = rep.batcher.manager
                 # probe in REVERSE transition order (queued, then active):
                 # admit() inserts into active BEFORE discarding from the
@@ -601,7 +829,7 @@ class ReplicaRouter:
                     break              # oldest route still live: wait
             self._route_order.popleft()
             del self._routes[head]
-            self._by_loc.pop((route.replica, route.uid), None)
+            self._by_loc.pop((route.replica, route.inc, route.uid), None)
 
     # ------------------------------------------------------------------
     # signals + reporting
@@ -609,10 +837,12 @@ class ReplicaRouter:
     def install_signal_handlers(self, drain: Optional[str] = None) -> None:
         """SIGTERM → drain ``drain`` (one replica) or the whole pool, with
         queue migration, from a helper thread (a signal handler must not
-        block on worker handshakes)."""
-        names = [drain] if drain is not None else list(self.replicas)
+        block on worker handshakes). The pool membership is read at
+        SIGNAL time — an elastic pool may have scaled since install."""
 
         def _on_sigterm(signum, frame):
+            names = ([drain] if drain is not None
+                     else [r.name for r in self._snapshot()])
             logger.warning(f"serving: router SIGTERM — draining {names}")
             threading.Thread(target=self._drain_many, args=(names,),
                              daemon=True).start()
@@ -641,6 +871,6 @@ class ReplicaRouter:
             "health": self.health,
             "counters": counters,
             "routes": routes,
-            "replicas": {name: rep.report()
-                         for name, rep in self.replicas.items()},
+            "replicas": {rep.name: rep.report()
+                         for rep in self._snapshot()},
         }

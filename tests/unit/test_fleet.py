@@ -1,0 +1,340 @@
+"""Elastic-fleet tests: replica lifecycle (crash recovery, readmission,
+scaling), the warm-start cache, and the new fault-injection sites.
+
+Fast tests exercise the router's elasticity surface and the
+:class:`WarmStartCache` directly; the end-to-end crash/scale/swap storms
+live in ``tools/elastic_drill.py`` with slow pytest wrappers at the
+bottom (``elastic`` + ``slow`` markers, like the chaos/serving drills)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+sys.path.insert(0, _TOOLS)
+
+TERMINAL = ("completed", "shed", "expired")
+
+
+def _make_replica(name, cache=None, key=None, **serving):
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.serving import ContinuousBatcher, Replica
+
+    ekw = dict(max_sequences=8, max_seq_len=128, block_size=16)
+    if cache is not None:
+        eng, info = cache.build_engine(
+            key, lambda: TransformerLM(get_preset("tiny")), engine_kw=ekw)
+    else:
+        eng = InferenceEngineV2(TransformerLM(get_preset("tiny")), **ekw)
+        info = None
+    cfg = ServingConfig(**{"prefill_chunk": 32,
+                           "default_max_new_tokens": 4, **serving})
+    rep = Replica(name, ContinuousBatcher(eng, cfg))
+    if info is not None:
+        rep.start_info = info
+    return rep
+
+
+def _await(fn, timeout_s=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection sites
+# ---------------------------------------------------------------------------
+@pytest.mark.elastic
+class TestReplicaFaultSites:
+    def test_new_kinds_accepted(self):
+        from deepspeed_tpu.resilience.faults import FaultSpec
+
+        for kind in ("replica_crash", "slow_start", "weight_load_io_error"):
+            assert FaultSpec(kind=kind).kind == kind
+
+    def test_replica_crash_site_pinning(self):
+        from deepspeed_tpu.resilience.faults import (FaultInjector,
+                                                     FaultSpec,
+                                                     InjectedCrash)
+
+        inj = FaultInjector([FaultSpec(kind="replica_crash", site="r1")])
+        inj.on_replica_loop("r0")            # pinned elsewhere: no fire
+        with pytest.raises(InjectedCrash):
+            inj.on_replica_loop("r1")
+        inj.on_replica_loop("r1")            # occurrence-counted: once
+
+    def test_slow_start_sleeps(self):
+        from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+        inj = FaultInjector([FaultSpec(kind="slow_start", delay_s=0.05)])
+        t0 = time.monotonic()
+        inj.on_replica_start("r0")
+        assert time.monotonic() - t0 >= 0.05
+        assert inj.fired and "replica_start" in inj.fired[0]
+
+    def test_weight_load_io_error_sited(self):
+        from deepspeed_tpu.resilience.faults import (FaultInjector,
+                                                     FaultSpec,
+                                                     InjectedIOError)
+
+        inj = FaultInjector([FaultSpec(kind="weight_load_io_error",
+                                       site="warm")])
+        inj.on_weight_load("publish")        # other site: no fire
+        with pytest.raises(InjectedIOError):
+            inj.on_weight_load("warm")
+
+
+# ---------------------------------------------------------------------------
+# fleet config
+# ---------------------------------------------------------------------------
+@pytest.mark.elastic
+class TestFleetConfig:
+    def test_defaults_valid(self):
+        from deepspeed_tpu.config.config import FleetConfig, ServingConfig
+
+        cfg = FleetConfig()
+        assert cfg.min_replicas <= cfg.max_replicas
+        assert ServingConfig().fleet.min_ready_floor >= 0
+
+    @pytest.mark.parametrize("bad", [
+        {"min_replicas": 0},
+        {"min_replicas": 4, "max_replicas": 2},
+        {"heartbeat_timeout_s": 0.0},
+        {"max_respawns": 0},
+        {"scale_up_polls": 0},
+    ])
+    def test_bounds_rejected(self, bad):
+        from deepspeed_tpu.config.config import FleetConfig
+
+        with pytest.raises(Exception):
+            FleetConfig(**bad)
+
+
+# ---------------------------------------------------------------------------
+# warm-start cache (no engine needed for the weight roundtrip)
+# ---------------------------------------------------------------------------
+@pytest.mark.elastic
+class TestWarmStartCache:
+    def _tree(self):
+        rng = np.random.default_rng(0)
+        return {"wte": rng.standard_normal((8, 4)).astype(np.float32),
+                "blocks": [{"w": rng.standard_normal((4, 4))
+                            .astype(np.float32)} for _ in range(2)],
+                "scale": np.float32(2.5)}
+
+    def test_flatten_roundtrip(self):
+        from deepspeed_tpu.serving.coldstart import _flatten, _unflatten
+
+        tree = self._tree()
+        rebuilt = _unflatten([(list(map(list, p)), leaf)
+                              for p, leaf in _flatten(tree)])
+        assert sorted(rebuilt) == sorted(tree)
+        np.testing.assert_array_equal(rebuilt["wte"], tree["wte"])
+        np.testing.assert_array_equal(rebuilt["blocks"][1]["w"],
+                                      tree["blocks"][1]["w"])
+
+    def test_publish_load_roundtrip(self, tmp_path):
+        from deepspeed_tpu.serving import WarmStartCache
+
+        cache = WarmStartCache(str(tmp_path))
+        tree = self._tree()
+        assert cache.publish("k1", tree)
+        assert cache.has_params("k1")
+        # a SECOND cache instance on the same dir (fresh process stand-in)
+        other = WarmStartCache(str(tmp_path))
+        out = other.load_params("k1")
+        np.testing.assert_array_equal(out["wte"], tree["wte"])
+        np.testing.assert_array_equal(out["blocks"][0]["w"],
+                                      tree["blocks"][0]["w"])
+        assert other.counters["warm_loads"] == 1
+
+    def test_corrupt_manifest_raises_cleanly(self, tmp_path):
+        from deepspeed_tpu.serving import WarmStartCache
+
+        cache = WarmStartCache(str(tmp_path))
+        cache.publish("k1", self._tree())
+        with open(cache.manifest_path("k1"), "w") as f:
+            f.write("{not json at all")
+        with pytest.raises((OSError, ValueError)):
+            cache.load_params("k1")
+
+    def test_torn_swap_file_raises_cleanly(self, tmp_path):
+        from deepspeed_tpu.serving import WarmStartCache
+
+        cache = WarmStartCache(str(tmp_path))
+        cache.publish("k1", self._tree())
+        swap_dir = os.path.join(tmp_path, "weights")   # swapper namespace
+        swps = [os.path.join(swap_dir, p) for p in os.listdir(swap_dir)
+                if p.endswith(".swp")]
+        victim = max(swps, key=os.path.getsize)
+        with open(victim, "r+b") as f:
+            f.truncate(max(os.path.getsize(victim) // 2, 1))
+        fresh = WarmStartCache(str(tmp_path))   # no in-memory meta
+        with pytest.raises((OSError, ValueError)):
+            fresh.load_params("k1")
+
+    def test_injected_io_error_on_load(self, tmp_path):
+        from deepspeed_tpu.resilience.faults import (FaultInjector,
+                                                     FaultSpec,
+                                                     InjectedIOError,
+                                                     set_injector)
+        from deepspeed_tpu.serving import WarmStartCache
+
+        cache = WarmStartCache(str(tmp_path))
+        cache.publish("k1", self._tree())
+        set_injector(FaultInjector(
+            [FaultSpec(kind="weight_load_io_error", site="warm")]))
+        try:
+            with pytest.raises(InjectedIOError):
+                cache.load_params("k1")
+        finally:
+            set_injector(None)
+        assert cache.load_params("k1")["wte"].shape == (8, 4)
+
+    def test_evict_module(self):
+        from deepspeed_tpu.serving.coldstart import _MODULES, evict_module
+
+        _MODULES["tmp_key"] = object()
+        assert evict_module("tmp_key")
+        assert not evict_module("tmp_key")
+
+
+# ---------------------------------------------------------------------------
+# router elasticity surface (readmit / add / remove / retired ledger)
+# ---------------------------------------------------------------------------
+@pytest.mark.elastic
+@pytest.mark.serving
+class TestRouterElasticity:
+    def test_crash_respawn_readmit_resolves_old_uids(self, tmp_path,
+                                                     eight_devices):
+        from deepspeed_tpu.resilience.faults import (FaultInjector,
+                                                     FaultSpec,
+                                                     set_injector)
+        from deepspeed_tpu.serving import (FleetController, ReplicaRouter,
+                                           WarmStartCache, warm_key)
+        from deepspeed_tpu.config.config import FleetConfig
+        from deepspeed_tpu.models import TransformerLM, get_preset
+
+        cache = WarmStartCache(str(tmp_path))
+        key = warm_key(TransformerLM(get_preset("tiny")))
+        factory = lambda name: _make_replica(name, cache=cache, key=key)
+        router = ReplicaRouter([factory("r0"), factory("r1")]).start()
+        fc = FleetController(router, factory,
+                             FleetConfig(respawn_backoff_s=0.0))
+        try:
+            uids = [router.submit([1, 2, 3], max_new_tokens=4)
+                    for _ in range(12)]
+            set_injector(FaultInjector(
+                [FaultSpec(kind="replica_crash", site="r0")]))
+            assert _await(lambda: not router.replicas["r0"].alive, 15)
+            set_injector(None)
+            actions = fc.poll()
+            assert actions["recovered"] and \
+                actions["recovered"][0]["respawned"]
+            assert router.replicas["r0"].alive
+            assert router.replicas["r0"].incarnation > 0
+            # every pre-crash uid still resolves (retired ledger for the
+            # dead incarnation, live ledger for the survivor)
+            assert _await(lambda: all(
+                router.resolve(u) in TERMINAL for u in uids), 60)
+            # loud sheds, not silence, for crash-severed requests
+            states = [router.resolve(u) for u in uids]
+            assert all(s in TERMINAL for s in states)
+            # the respawn takes new traffic
+            uid = router.submit([4, 5], max_new_tokens=2)
+            assert _await(lambda: router.resolve(uid) in TERMINAL, 30)
+            assert router.counters["readmits"] == 1
+        finally:
+            set_injector(None)
+            router.close()
+            fc.close()
+
+    def test_add_remove_guards(self):
+        import threading
+
+        from deepspeed_tpu.serving import ReplicaRouter
+
+        r0, r1 = _make_replica_stub("r0"), _make_replica_stub("r1")
+        router = ReplicaRouter([r0, r1])       # never started: no threads
+        with pytest.raises(ValueError):
+            router.add_replica(_make_replica_stub("r0"))   # duplicate name
+        # fake a live worker so r0 counts as routable
+        gate = threading.Event()
+        t = threading.Thread(target=gate.wait, daemon=True)
+        t.start()
+        r0._thread = t
+        try:
+            with pytest.raises(RuntimeError):
+                router.remove_replica("r0")    # still routable
+        finally:
+            gate.set()
+            t.join(timeout=5)
+        removed = router.remove_replica("r0")  # dead now: removable
+        assert removed is r0 and "r0" not in router.replicas
+        with pytest.raises(RuntimeError):
+            router.remove_replica("r1")        # never the last replica
+        with pytest.raises(KeyError):
+            router.remove_replica("nope")
+
+    def test_readmit_requires_ready_and_name_match(self):
+        from deepspeed_tpu.serving import ReplicaRouter
+
+        router = ReplicaRouter([_make_replica_stub("r0")])
+        with pytest.raises(ValueError):
+            router.readmit("r0", _make_replica_stub("other"))
+        with pytest.raises(RuntimeError):
+            # replacement never started -> not alive
+            router.readmit("r0", _make_replica_stub("r0"))
+
+    def test_retired_ledger_is_bounded(self):
+        from deepspeed_tpu.serving import ReplicaRouter
+
+        router = ReplicaRouter([_make_replica_stub("r0"),
+                                _make_replica_stub("r1")])
+        for _ in range(router._max_retired + 5):
+            with router._lock:
+                router._retire_locked(_make_replica_stub("r0"))
+        assert len(router._retired) == router._max_retired
+
+
+class _StubBatcher:
+    """The minimal batcher surface Replica touches without a worker."""
+
+    def __init__(self):
+        self.health = "starting"
+        self.drained = False
+        self.manager = None            # only the retired-ledger key needs it
+
+    def close(self):
+        pass
+
+
+def _make_replica_stub(name):
+    """An UNSTARTED replica (no engine build) for guard tests."""
+    from deepspeed_tpu.serving.router import Replica
+
+    return Replica(name, _StubBatcher())
+
+
+# ---------------------------------------------------------------------------
+# slow end-to-end drill wrappers
+# ---------------------------------------------------------------------------
+@pytest.mark.elastic
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["replica-crash-mid-storm",
+                                      "burst-autoscale", "rolling-swap",
+                                      "cold-start-bench"])
+def test_elastic_scenario(scenario, tmp_path, eight_devices):
+    from elastic_drill import run_scenario
+
+    verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict

@@ -638,6 +638,7 @@ def test_router_route_reads_hold_the_lock():
     class _StubReplica:
         def __init__(self, name):
             self.name = name
+            self.incarnation = 0
             self.cancelled = []
             self.resolved = []
 
@@ -659,7 +660,7 @@ def test_router_route_reads_hold_the_lock():
             return super().get(key, default)
 
     routes = _LockAssertingRoutes()
-    routes[7] = _Route("r0", 42, None)
+    routes[7] = _Route("r0", rep.incarnation, 42, None)
     router._routes = routes
 
     assert router.cancel(7) is True

@@ -1,0 +1,163 @@
+"""Robustness of the on-disk tuning caches a replica touches at startup:
+the mesh autotuner's ``WinnerStore`` and the AIO bench's autotune cache.
+
+A corrupt, torn, or concurrently-written cache file must degrade to "no
+cached answer" (cost-model / default fallback) — never crash engine init
+or a respawn. These are the same torn-file semantics the warm-start
+weight cache is drilled for in ``test_fleet.py``."""
+
+import json
+import os
+import threading
+
+import pytest
+
+
+@pytest.mark.elastic
+@pytest.mark.scaling
+class TestWinnerStoreRobustness:
+    def _store(self, tmp_path):
+        from deepspeed_tpu.autotuning.mesh_store import WinnerStore
+
+        return WinnerStore(str(tmp_path / "winners.json"))
+
+    def test_corrupt_json_falls_back_empty(self, tmp_path):
+        st = self._store(tmp_path)
+        with open(st.path, "w") as f:
+            f.write("{definitely not json")
+        assert st.get("sig", 8, "cpu") is None
+        # put() heals the file
+        st.put("sig", 8, "cpu", {"dp": 8}, 123.0)
+        assert st.get("sig", 8, "cpu")["metric"] == 123.0
+
+    def test_torn_file_falls_back_empty(self, tmp_path):
+        st = self._store(tmp_path)
+        st.put("sig", 8, "cpu", {"dp": 8}, 123.0)
+        size = os.path.getsize(st.path)
+        with open(st.path, "r+b") as f:
+            f.truncate(size // 2)
+        assert st.get("sig", 8, "cpu") is None
+
+    def test_wrong_schema_falls_back_empty(self, tmp_path):
+        st = self._store(tmp_path)
+        with open(st.path, "w") as f:
+            json.dump({"schema": 999, "winners": {"x": {}}}, f)
+        assert st.get("sig", 8, "cpu") is None
+
+    def test_missing_file_ok(self, tmp_path):
+        st = self._store(tmp_path)
+        assert st.get("sig", 8, "cpu") is None
+
+    def test_concurrent_puts_leave_valid_file(self, tmp_path):
+        st = self._store(tmp_path)
+        errors = []
+
+        def hammer(i):
+            try:
+                for j in range(10):
+                    st.put(f"sig{i}", 8, "cpu", {"dp": 8}, float(i * 10 + j))
+            except Exception as e:   # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # whatever interleaving won, the file is valid schema'd JSON and
+        # readable (atomic tmp+rename: no torn merge states)
+        with open(st.path) as f:
+            data = json.load(f)
+        assert data["schema"] and isinstance(data["winners"], dict)
+        assert st.get("sig0", 8, "cpu") is None or \
+            st.get("sig0", 8, "cpu")["metric"] >= 0
+
+    def test_resolve_auto_never_raises_on_damage(self, tmp_path):
+        """The ``mesh: auto`` ladder with a damaged winner cache: cost
+        model / all-dp fallback, never an exception into engine init."""
+        from deepspeed_tpu.autotuning.mesh_store import (
+            WinnerStore, resolve_auto_axis_sizes)
+
+        cache = str(tmp_path / "winners.json")
+        for damage in ("{torn", "", json.dumps([1, 2, 3]),
+                       json.dumps({"schema": 1, "winners": "not-a-dict"})):
+            with open(cache, "w") as f:
+                f.write(damage)
+            axes = resolve_auto_axis_sizes(8, None, winner_cache=cache,
+                                           kind="cpu")
+            assert isinstance(axes, dict)
+            assert all(isinstance(v, int) for v in axes.values())
+        # and a healthy winner is actually adopted afterwards
+        WinnerStore(cache).put("m", 8, "cpu", {"dp": 4, "tp": 2}, 50.0)
+
+
+@pytest.mark.elastic
+class TestAioAutotuneCacheRobustness:
+    def _fake_sweep(self, monkeypatch):
+        from deepspeed_tpu.ops import aio_bench
+
+        calls = []
+
+        def sweep(bench_dir, **kw):
+            calls.append(bench_dir)
+            return [{"threads": 2, "chunk_mb": 4,
+                     "read_MBps": 100.0, "write_MBps": 80.0}]
+
+        monkeypatch.setattr(aio_bench, "sweep", sweep)
+        return calls
+
+    def test_corrupt_cache_rebenches_and_heals(self, tmp_path, monkeypatch):
+        from deepspeed_tpu.ops.aio_bench import autotune_config
+
+        calls = self._fake_sweep(monkeypatch)
+        cache = str(tmp_path / "aio_cache.json")
+        with open(cache, "w") as f:
+            f.write("~~~corrupt~~~")
+        cfg = autotune_config(str(tmp_path / "swap"), cache_path=cache)
+        assert cfg["threads"] == 2 and len(calls) == 1
+        # healed: the second call is a cache hit, no re-sweep
+        cfg2 = autotune_config(str(tmp_path / "swap"), cache_path=cache)
+        assert cfg2["threads"] == 2 and len(calls) == 1
+
+    def test_swapper_survives_corrupt_autotune_cache(self, tmp_path,
+                                                     monkeypatch):
+        """Engine-init path: an AsyncTensorSwapper with autotune enabled
+        and a corrupt cache must come up (defaults or re-bench), never
+        raise out of __init__."""
+        import numpy as np
+
+        from deepspeed_tpu.offload.swap import AsyncTensorSwapper
+
+        self._fake_sweep(monkeypatch)
+        cache = str(tmp_path / "aio_cache.json")
+        with open(cache, "w") as f:
+            f.write('{"truncated": ')
+        sw = AsyncTensorSwapper(str(tmp_path / "swap"), autotune=True,
+                                autotune_cache=cache)
+        arr = np.arange(32, dtype=np.float32)
+        sw.swap_out("t0", arr)
+        sw.wait()
+        ticket, segments = sw.swap_in_start_many(["t0"])
+        try:
+            flat = ticket.wait()
+            off, nbytes = segments["t0"]
+            out = np.frombuffer(flat[off:off + nbytes].tobytes(),
+                                dtype=np.float32)
+        finally:
+            ticket.release()
+        np.testing.assert_array_equal(out, arr)
+
+    def test_sweep_failure_degrades_to_defaults(self, tmp_path,
+                                                monkeypatch):
+        from deepspeed_tpu.offload.swap import AsyncTensorSwapper
+        from deepspeed_tpu.ops import aio_bench
+
+        def boom(*a, **kw):
+            raise OSError("injected bench failure")
+
+        monkeypatch.setattr(aio_bench, "sweep", boom)
+        sw = AsyncTensorSwapper(str(tmp_path / "swap"), autotune=True,
+                                autotune_cache=str(tmp_path / "c.json"))
+        assert sw.autotuned is None          # fell back, did not raise

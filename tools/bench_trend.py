@@ -60,6 +60,11 @@ TRACKED = {
     "bench_zero_pp": [("all_gather_reduction", "higher"),
                       ("reduce_scatter_reduction", "higher"),
                       ("quantized.tokens_per_sec", "higher")],
+    # elastic fleet (tools/elastic_drill.py): the raw figures are wall
+    # times (lower-is-better), so the gate rides their higher-is-better
+    # restatements — warm-over-cold start speedup and rejoins per second
+    "bench_elastic": [("warm_speedup", "higher"),
+                      ("rejoin_per_sec", "higher")],
 }
 
 
