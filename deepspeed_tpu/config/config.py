@@ -739,6 +739,73 @@ class FleetConfig(DSTpuConfigModel):
         return self
 
 
+_SLO_TIERS = ("latency", "throughput", "batch")
+
+
+class SLOConfig(DSTpuConfigModel):
+    """``serving.slo``: SLO tiers + preemptible (pausable) requests.
+
+    Every request carries a tier — ``latency`` (chat), ``throughput``
+    (agents), ``batch`` (offline / spot). When enabled, the batcher (a)
+    enforces per-tier admission *budgets* (a tier over budget WAITS in the
+    queue instead of admitting — it is never terminally shed for being
+    over budget), and (b) answers KV pressure by PAUSING victims — the
+    victim's per-request KV blocks demote through the tier store exactly
+    like prefix-cache blocks, freeing HBM; the request resumes later with
+    bit-identical greedy tokens. Victim order: batch before throughput
+    before latency, deadline-free first, most-remaining-work first; a
+    request is never paused twice before it advances (starvation guard).
+    Batch tier is the "spot" contract: admitted only into spare capacity,
+    preempted at will, told to back off hardest on 429."""
+
+    enabled: bool = False
+    default_tier: str = "throughput"
+    # per-tier admission budgets as fractions of the batcher's KV
+    # admission budget (projected worst-case blocks); 1.0 = no per-tier
+    # cap beyond the pool-wide watermark admission check
+    budgets: Dict[str, float] = Field(default_factory=lambda: {
+        "latency": 1.0, "throughput": 1.0, "batch": 1.0})
+    # pause victims instead of shedding them under KV pressure (False
+    # keeps tiers/budgets but falls back to the terminal shed)
+    preempt: bool = True
+    # pause cycles per request before the batcher gives up and sheds it
+    # retryably (a pathological thrasher must not ping-pong forever)
+    max_pauses: int = 4
+    # paused requests resumed per step while capacity allows — resuming
+    # one at a time keeps the promote fence payload bounded
+    resume_max_per_step: int = 1
+    # pinned-host budget for paused-request KV when the prefix-cache tier
+    # store is not configured (the pause path then creates its own store)
+    pause_host_mb: float = 64.0
+    # Retry-After multiplier per tier: batch-tier 429 hints back off
+    # harder than latency-tier ones under the same pressure
+    retry_after_factor: Dict[str, float] = Field(default_factory=lambda: {
+        "latency": 1.0, "throughput": 1.0, "batch": 4.0})
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.default_tier not in _SLO_TIERS:
+            raise ValueError(f"serving.slo.default_tier must be one of "
+                             f"{list(_SLO_TIERS)}")
+        for name, table in (("budgets", self.budgets),
+                            ("retry_after_factor", self.retry_after_factor)):
+            unknown = set(table) - set(_SLO_TIERS)
+            if unknown:
+                raise ValueError(f"serving.slo.{name}: unknown tiers "
+                                 f"{sorted(unknown)}")
+        if any(not (0.0 < v <= 1.0) for v in self.budgets.values()):
+            raise ValueError("serving.slo.budgets values must be in (0, 1]")
+        if any(v <= 0 for v in self.retry_after_factor.values()):
+            raise ValueError(
+                "serving.slo.retry_after_factor values must be > 0")
+        if self.max_pauses < 0 or self.resume_max_per_step < 1:
+            raise ValueError("serving.slo: max_pauses must be >= 0 and "
+                             "resume_max_per_step >= 1")
+        if self.pause_host_mb <= 0:
+            raise ValueError("serving.slo.pause_host_mb must be > 0")
+        return self
+
+
 class ServingConfig(DSTpuConfigModel):
     """``serving`` section: the request-lifecycle layer above
     ``InferenceEngineV2`` (``deepspeed_tpu/serving``) — bounded admission,
@@ -784,6 +851,7 @@ class ServingConfig(DSTpuConfigModel):
     frontend: FrontendConfig = Field(default_factory=FrontendConfig)
     router: RouterConfig = Field(default_factory=RouterConfig)
     fleet: FleetConfig = Field(default_factory=FleetConfig)
+    slo: SLOConfig = Field(default_factory=SLOConfig)
 
     @model_validator(mode="after")
     def _check(self):

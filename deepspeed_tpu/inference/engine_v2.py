@@ -36,6 +36,24 @@ from deepspeed_tpu.utils.logging import log_dist
 _MIN_TILE = 32
 
 
+class _PausedSeq:
+    """Host-side record of a PREEMPTED (paused) sequence: the tier-store
+    keys holding its demoted KV pages, the frontier to restore, and the
+    committed-token history the flush would otherwise discard. Store keys
+    are NEGATIVE so they can never collide with the prefix cache's
+    non-negative promote handles in a shared tier store."""
+
+    __slots__ = ("uid", "keys", "seen", "hist", "paused_t", "resuming")
+
+    def __init__(self, uid: int, keys, seen: int, hist):
+        self.uid = uid
+        self.keys = list(keys)
+        self.seen = int(seen)
+        self.hist = hist
+        self.paused_t = time.perf_counter()
+        self.resuming = False
+
+
 class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params=None, max_sequences: int = 8,
                  max_seq_len: Optional[int] = None, block_size: int = 128,
@@ -196,6 +214,18 @@ class InferenceEngineV2:
         self._tier_store = None
         self._promote_q: list = []
         self._promote_ms = None
+        self._promote_step = None   # lazy: tiers branch or first pause
+        # serving preemption (pause/resume) state: paused-request KV parks
+        # in the SAME tier store as demoted prefix blocks; uploads ride the
+        # same promote fence. Negative keys namespace them apart.
+        self._paused: Dict[int, _PausedSeq] = {}
+        self._pause_q: list = []        # resume uploads awaiting the fence
+        self._resume_failed: list = []  # uids whose resume tier read failed
+        self._pause_key = -1
+        # pinned-host budget used when the pause path must create its own
+        # store (prefix tiers off); the serving layer overrides from
+        # serving.slo.pause_host_mb before the first pause
+        self.pause_store_mb = 64.0
         if self.prefix_cfg.enabled:
             from deepspeed_tpu.observability import get_registry
 
@@ -316,6 +346,11 @@ class InferenceEngineV2:
             self.state.flush(uid)
             if self._hist is not None:
                 self._hist.pop(uid, None)
+            if self._paused:
+                # a PAUSED request resolving terminal (expire/cancel/drain)
+                # flushes through the same path a live one does — its
+                # parked tier entries must go with it or the store leaks
+                self._drop_paused(uid)
 
     # ---- prefix-cache KV reuse -------------------------------------------
     def prefix_attach(self, uid: int, tokens) -> int:
@@ -425,7 +460,11 @@ class InferenceEngineV2:
         attach time overlap all host-side batch building in between. A
         payload whose tier read failed is zero-filled (loudly) — the
         sequence computes on zeros rather than on whatever the evicted
-        block left behind."""
+        block left behind. Pending RESUME uploads (paused requests) ride
+        the same fence first — their blocks must also be whole before any
+        attention read."""
+        if self._pause_q:
+            self._flush_pause_promotes()
         recs, self._promote_q = self._promote_q, []
         if not recs:
             return
@@ -522,7 +561,254 @@ class InferenceEngineV2:
         if self._tier_store is None:
             return None
         return {**self._tier_store.report(),
-                "pending_promotes": len(self._promote_q)}
+                "pending_promotes": len(self._promote_q),
+                "paused_requests": len(self._paused),
+                "pending_resumes": len(self._pause_q)}
+
+    # ---- serving preemption: pause / resume through the tier store -------
+    def _ensure_pause_store(self):
+        """The pause path's tier store + promote jit, created on first use
+        when ``inference.prefix_cache.tiers`` is off (paused KV then lives
+        in an engine-private host-only store; the prefix cache never sees
+        it)."""
+        if self._tier_store is None:
+            from deepspeed_tpu.inference.kv_tier import KVTierStore
+
+            self._tier_store = KVTierStore(
+                host_mb=float(self.pause_store_mb))
+        if self._promote_step is None:
+            self._promote_step = jax.jit(self._promote_impl,
+                                         donate_argnums=(0,),
+                                         out_shardings=self._kv_out)
+        return self._tier_store
+
+    def is_paused(self, uid: int) -> bool:
+        return uid in self._paused
+
+    def paused_blocks(self, uid: int) -> int:
+        """Pool blocks a paused uid needs back to resume (0 = not paused)."""
+        rec = self._paused.get(uid)
+        return 0 if rec is None else len(rec.keys)
+
+    def can_resume(self, uid: int) -> bool:
+        """Capacity probe: a free slot + enough free-or-evictable blocks to
+        re-materialise the paused sequence."""
+        rec = self._paused.get(uid)
+        if rec is None or rec.resuming:
+            return False
+        return (bool(self.state._free_slots)
+                and len(rec.keys) <= self.state._available_blocks())
+
+    def pause_request(self, uid: int) -> bool:
+        """PREEMPT a live sequence: demote its KV pages into the tier store
+        (exactly the prefix-demotion byte path) and free its HBM blocks +
+        slot through the normal flush mechanics. Returns False — with NO
+        side effects — when the uid has no pausable state (unknown, already
+        paused, mid-step, nothing in KV yet) or the store cannot hold the
+        pages; the caller falls back to a plain shed."""
+        if not (self.paged and self.packed):
+            return False
+        seq = self.state.sequences.get(uid)
+        if seq is None or uid in self._paused or seq.in_flight:
+            return False
+        seen = int(seq.seen_tokens)
+        if seen <= 0:
+            return False
+        t0 = time.perf_counter()
+        nb = -(-seen // self.block_size)
+        blocks = seq.blocks[:nb]
+        store = self._ensure_pause_store()
+        payloads = self._extract_blocks(blocks)
+        keys = []
+        for parts in payloads:
+            key = self._pause_key
+            self._pause_key -= 1
+            if not store.put(key, parts):
+                for k in keys:
+                    store.discard(k)
+                return False
+            keys.append(key)
+        hist = None
+        if self._hist is not None:
+            hist = self._hist.get(uid)
+        self._paused[uid] = _PausedSeq(uid, keys, seen, hist)
+        # release HBM + slot the same way a terminal flush does (shared
+        # prefix blocks just lose this sequence's reference — the snapshot
+        # above captured their bytes, so resume never depends on the tree)
+        self._pos[seq.slot] = 0
+        self.state.flush(uid)
+        if self._hist is not None:
+            self._hist.pop(uid, None)
+        bus = self._ebus
+        if bus.enabled:
+            bus.instant("kv_tier", "pause",
+                        args={"uid": int(uid), "blocks": nb,
+                              "seen_tokens": seen,
+                              "ms": round((time.perf_counter() - t0) * 1e3,
+                                          3)})
+        return True
+
+    def resume_request(self, uid: int) -> bool:
+        """Begin resuming a paused uid: fresh slot + freshly allocated
+        blocks, tier reads started; the payload upload fences before the
+        next device step (the :meth:`_flush_promotes` discipline). Returns
+        False when there is no capacity yet (try again later) — or when
+        the parked entries were lost, in which case the uid is also queued
+        on the resume-failure list (:meth:`flush_resumes` drains it) so
+        the serving layer sheds it retryably instead of retrying forever."""
+        rec = self._paused.get(uid)
+        if rec is None or rec.resuming or self._tier_store is None:
+            return False
+        if not self.can_resume(uid):
+            return False
+        store = self._tier_store
+        try:
+            seq = self.state.restore(uid, len(rec.keys), rec.seen)
+        except (RuntimeError, ValueError):
+            return False
+        fetches = []
+        store.begin_chain(rec.keys)
+        try:
+            for key in rec.keys:
+                f = store.fetch_start(key)
+                if f is None:         # entry dropped under store pressure
+                    raise KeyError(key)
+                fetches.append(f)
+        except BaseException:
+            for f in fetches:
+                f.release()
+            store.end_chain()
+            # the parked KV is gone: unwind the restore completely (the
+            # request must never see zeroed KV) and report the loss
+            self._pos[seq.slot] = 0
+            self.state.flush(uid)
+            self._drop_paused(uid)
+            self._resume_failed.append(uid)
+            return False
+        store.end_chain()
+        rec.resuming = True
+        self._pos[seq.slot] = rec.seen
+        if self._hist is not None and rec.hist is not None:
+            self._hist[uid] = rec.hist
+        self._pause_q.append((uid, rec, list(seq.blocks), fetches))
+        bus = self._ebus
+        if bus.enabled:
+            bus.instant("kv_tier", "resume_start",
+                        args={"uid": int(uid), "blocks": len(rec.keys),
+                              "seen_tokens": rec.seen})
+        return True
+
+    def flush_resumes(self) -> list:
+        """Force pending resume uploads NOW and return the uids whose tier
+        read failed (drained). The batcher calls this right after
+        ``resume_request`` so a failure is known BEFORE the request rejoins
+        the plan; the dispatch-site fences also run it, so correctness
+        never depends on the caller."""
+        self._flush_pause_promotes()
+        failed, self._resume_failed = self._resume_failed, []
+        return failed
+
+    def _unwind_resume(self, uid: int, fetches) -> None:
+        """A resume that cannot complete: give back loans, blocks, slot and
+        the parked entries; the uid lands on the resume-failure list."""
+        for f in fetches:
+            f.release()
+        seq = self.state.sequences.get(uid)
+        if seq is not None:
+            self._pos[seq.slot] = 0
+            self.state.flush(uid)
+        if self._hist is not None:
+            self._hist.pop(uid, None)
+        self._drop_paused(uid)
+        self._resume_failed.append(uid)
+
+    def _flush_pause_promotes(self) -> None:
+        """Upload every pending resume's parked pages into its new pool
+        blocks. A failed tier read NEVER zero-fills here (unlike a prefix
+        promote, which only costs recompute): a sequence resumed over
+        zeros would decode garbage as its own past, so the whole resume is
+        unwound instead and the uid reported failed."""
+        pending, self._pause_q = self._pause_q, []
+        if not pending:
+            return
+        from deepspeed_tpu.resilience.faults import get_injector
+
+        import logging
+
+        store = self._tier_store
+        inj = get_injector()
+        for j, (uid, rec, blocks, fetches) in enumerate(pending):
+            n = len(blocks)
+            kt = self.cache["k"]
+            npad = max(4, 1 << (n - 1).bit_length())
+            kp = np.zeros((kt.shape[0], npad) + kt.shape[2:], kt.dtype)
+            vp = np.zeros_like(kp)
+            sp = None
+            if "kv_scale" in self.cache:
+                st = self.cache["kv_scale"]
+                sp = np.zeros((st.shape[0], npad) + st.shape[2:], st.dtype)
+            idx = np.full((npad,), self.num_blocks, np.int32)
+            failed = False
+            for i, (key, fetch) in enumerate(zip(rec.keys, fetches)):
+                try:
+                    if inj:
+                        inj.on_resume_read(store.tier_of(key) or "host")
+                    parts = fetch.wait()
+                except Exception as e:
+                    log_dist(f"kv tier: resume read failed for uid {uid} "
+                             f"key {key} ({e}); unwinding resume",
+                             level=logging.WARNING)
+                    failed = True
+                    break
+                idx[i] = blocks[i]
+                kp[:, i] = parts["k"]
+                vp[:, i] = parts["v"]
+                if sp is not None:
+                    sp[:, i] = parts["kv_scale"]
+            if failed:
+                self._unwind_resume(uid, fetches)
+                continue
+            try:
+                with jax.sharding.set_mesh(self.mesh):
+                    if sp is None:
+                        self.cache = self._promote_step(
+                            self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                            jnp.asarray(vp))
+                    else:
+                        self.cache = self._promote_step(
+                            self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                            jnp.asarray(vp), jnp.asarray(sp))
+            except BaseException:
+                # upload never happened: unwind this uid, then surface —
+                # the pool was not touched, later pendings re-queue
+                self._unwind_resume(uid, fetches)
+                self._pause_q = list(pending[j + 1:]) + self._pause_q
+                raise
+            for f in fetches:
+                f.release()
+            self._drop_paused(uid)      # parked copies now redundant
+            bus = self._ebus
+            if bus.enabled:
+                bus.instant("kv_tier", "resume_upload",
+                            args={"uid": int(uid), "blocks": n})
+
+    def _drop_paused(self, uid: int) -> None:
+        """Forget a pause record: purge any in-flight resume (releasing
+        its loans) and discard the parked store entries. Idempotent."""
+        rec = self._paused.pop(uid, None)
+        if rec is None:
+            return
+        keep = []
+        for item in self._pause_q:
+            if item[0] == uid:
+                for f in item[3]:
+                    f.release()
+            else:
+                keep.append(item)
+        self._pause_q = keep
+        if self._tier_store is not None:
+            for key in rec.keys:
+                self._tier_store.discard(key)
 
     def close(self) -> None:
         """Idempotent teardown of host-side resources the engine stands up
@@ -538,6 +824,15 @@ class InferenceEngineV2:
                 if self.prefix_cache is not None:
                     self.prefix_cache.drop_failed_promote(rec.node)
             self._promote_q = []
+        if self._pause_q:
+            # in-flight resumes: release the loans; the pause records
+            # below discard the parked entries themselves
+            for _uid, _rec, _blocks, fetches in self._pause_q:
+                for f in fetches:
+                    f.release()
+            self._pause_q = []
+        for uid in list(self._paused):
+            self._drop_paused(uid)
         if self._tier_store is not None:
             self._tier_store.close()
             self._tier_store = None
@@ -707,7 +1002,7 @@ class InferenceEngineV2:
         tok0 = np.zeros((bpad,), np.int32)
         tok0[:B] = np.asarray(batch_tokens, np.int32).reshape(B)
         valid = np.arange(bpad) < B
-        if self._promote_q:
+        if self._promote_q or self._pause_q:
             self._flush_promotes()      # fence: no read of a promoted
         with jax.sharding.set_mesh(self.mesh):  # block before its upload
             out, self.cache = self._decode_loop(
@@ -858,7 +1153,7 @@ class InferenceEngineV2:
             goff[i] = g
             gidx[g:g + len(c)] = starts[i] + np.arange(len(c))
             g += len(c)
-        if self._promote_q:
+        if self._promote_q or self._pause_q:
             self._flush_promotes()      # promote-completion fence
         with jax.sharding.set_mesh(self.mesh):
             logits, self.cache = self._step_packed(
@@ -1014,7 +1309,7 @@ class InferenceEngineV2:
             ids[i, :len(c)] = c
             lengths[i] = len(c)
             slots[i] = d.slot
-        if self._promote_q:
+        if self._promote_q or self._pause_q:
             self._flush_promotes()      # promote-completion fence
         t_host = time.perf_counter()
         with jax.sharding.set_mesh(self.mesh):
@@ -1135,7 +1430,7 @@ class InferenceEngineV2:
             gather_idx = np.zeros((Bs,), np.int32)
             for i, c in enumerate(chunks):       # chunk end → next-token
                 gather_idx[i] = starts[i] + len(c) - 1
-            if self._promote_q:
+            if self._promote_q or self._pause_q:
                 self._flush_promotes()  # promote-completion fence
             t_host = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):

@@ -789,6 +789,38 @@ class SequenceManager:
         seq.published = len(seq.blocks)
         return seq
 
+    def restore(self, uid: int, n_blocks: int,
+                seen_tokens: int) -> SequenceDescriptor:
+        """Re-materialise a PAUSED sequence: a fresh slot + ``n_blocks``
+        freshly allocated private blocks holding ``seen_tokens`` tokens of
+        KV once the engine's tier promote lands. Unlike
+        :meth:`attach_prefix`, ``seen_tokens`` need not be block-aligned (a
+        pause can land mid-block) and the blocks are private
+        (``published=0`` — the prefix tree never saw the paused request's
+        decode suffix, so nothing here may be shared back through it)."""
+        if uid in self.sequences:
+            raise RuntimeError(f"restore on live uid {uid}")
+        bs = self.allocator.block_size
+        if n_blocks * bs < seen_tokens:
+            raise ValueError(f"restore: {n_blocks} blocks cannot hold "
+                             f"{seen_tokens} tokens (block_size={bs})")
+        short = n_blocks - self.allocator.free_blocks
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+        seen_tokens = int(seen_tokens)
+        seq = self.get_or_create(uid)
+        seq.seen_tokens = seen_tokens
+        seq.published = 0
+        try:
+            seq.blocks = list(self.allocator.allocate(n_blocks))
+        except RuntimeError:
+            # unwind the slot so a failed restore leaks nothing
+            self.sequences.pop(uid, None)
+            self._free_slots.append(seq.slot)
+            self.slot_generation[seq.slot] += 1
+            raise
+        return seq
+
     def can_schedule(self, uid: int, new_tokens: int) -> bool:
         seq = self.sequences.get(uid)
         seen = seq.seen_tokens if seq else 0

@@ -20,10 +20,18 @@ Metric schema (all under ``serving/``):
 * ``serving/shed_total`` (counter, label ``reason=``) — shed rate by cause;
 * ``serving/rejected_total`` (counter, label ``reason=``) — admission
   refusals (queue_full / draining);
+* ``serving/preemptions_total`` (counter, label ``tier=``) — SLO
+  preemptions (pause-through-the-tier-store), by victim tier;
+* ``serving/pause_ms`` / ``serving/resume_ms`` (histograms) — KV demote /
+  promote wall clock for one preemption cycle;
+* per-tier SLO children: ``serving/ttft_ms{tier=}`` /
+  ``serving/tpot_ms{tier=}`` — the latency/throughput/batch breakdown of
+  the headline histograms;
 * gauges: ``serving/health`` (0=starting 1=ready 2=degraded 3=draining),
-  ``serving/queue_depth`` (total, plus per-``{priority=}`` children — the
-  router's balancing signal), ``serving/active_requests``,
-  ``serving/kv_occupancy``.
+  ``serving/queue_depth`` (total, plus per-``{priority=}`` and
+  per-``{tier=}`` children — the router's balancing signal and the fleet
+  autoscaler's, respectively), ``serving/active_requests``,
+  ``serving/paused_requests``, ``serving/kv_occupancy``.
 """
 
 from __future__ import annotations
@@ -76,6 +84,16 @@ class ServingMetrics:
                                        "requests on the engine")
         self.kv_occupancy = r.gauge("serving/kv_occupancy",
                                     "paged KV pool occupancy [0, 1]")
+        # SLO preemption (pause/resume through the KV tier store)
+        self.paused_requests = r.gauge(
+            "serving/paused_requests",
+            "requests preempted and parked in the KV tier store")
+        self.pause_ms = r.histogram(
+            "serving/pause_ms", "preempt: KV demote wall clock (ms)",
+            bounds=_LAT_BOUNDS)
+        self.resume_ms = r.histogram(
+            "serving/resume_ms", "resume: KV promote wall clock (ms)",
+            bounds=_LAT_BOUNDS)
         # speculative decoding (n-gram draft + batched verify): acceptance
         # rate is the headline — accepted/drafted over the process lifetime
         self.spec_rounds = r.counter(
@@ -92,6 +110,10 @@ class ServingMetrics:
         self._sheds: Dict[str, object] = {}
         self._rejects: Dict[str, object] = {}
         self._qdepth_prio: Dict[str, object] = {}
+        self._qdepth_tier: Dict[str, object] = {}
+        self._preempts: Dict[str, object] = {}
+        self._ttft_tier: Dict[str, object] = {}
+        self._tpot_tier: Dict[str, object] = {}
 
     def record_spec_round(self, drafted: int, accepted: int) -> None:
         self.spec_rounds.inc()
@@ -130,6 +152,31 @@ class ServingMetrics:
                 labels={"reason": reason})
         return c
 
+    def preemption(self, tier: str):
+        c = self._preempts.get(tier)
+        if c is None:
+            c = self._preempts[tier] = self.registry.counter(
+                "serving/preemptions_total",
+                "SLO preemptions (pause through the KV tier store)",
+                labels={"tier": tier})
+        return c
+
+    def ttft_tier(self, tier: str):
+        h = self._ttft_tier.get(tier)
+        if h is None:
+            h = self._ttft_tier[tier] = self.registry.histogram(
+                "serving/ttft_ms", "submit -> first generated token (ms)",
+                bounds=_LAT_BOUNDS, labels={"tier": tier})
+        return h
+
+    def tpot_tier(self, tier: str):
+        h = self._tpot_tier.get(tier)
+        if h is None:
+            h = self._tpot_tier[tier] = self.registry.histogram(
+                "serving/tpot_ms", "inter-token decode gap (ms)",
+                bounds=_LAT_BOUNDS, labels={"tier": tier})
+        return h
+
     def set_health(self, health: str) -> None:
         self.health.set(float(HEALTH_CODES.get(health, -1)))
 
@@ -150,5 +197,25 @@ class ServingMetrics:
                     labels={"priority": key})
             g.set(float(depth))
         for key, g in self._qdepth_prio.items():
+            if key not in seen:
+                g.set(0.0)
+
+    def set_queue_depth_tiers(self, by_tier: Dict[str, int]) -> None:
+        """Per-SLO-tier breakdown as ``serving/queue_depth{tier=}`` gauge
+        children — the fleet autoscaler's pressure signal (batch-tier
+        backlog alone must not scale the fleet up). Empty tiers zero out,
+        same ghost-backlog rule as the priority children."""
+        seen = set()
+        for tier, depth in by_tier.items():
+            key = str(tier)
+            seen.add(key)
+            g = self._qdepth_tier.get(key)
+            if g is None:
+                g = self._qdepth_tier[key] = self.registry.gauge(
+                    "serving/queue_depth",
+                    "requests waiting for admission",
+                    labels={"tier": key})
+            g.set(float(depth))
+        for key, g in self._qdepth_tier.items():
             if key not in seen:
                 g.set(0.0)

@@ -33,6 +33,13 @@ Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
   ``decode_nan`` poisons a step's returned logits so the batcher's failure
   window and degraded mode are exercised, and ``shed_storm`` forces the
   watermark-shedding path for ``times`` consecutive serving steps.
+* SLO-preemption sites (``serving/batcher.py`` pause/resume, drilled by
+  ``tools/serve_drill.py --scenario slo-storm``): ``preempt_storm`` forces
+  victim selection (the pause path) for ``times`` consecutive serving
+  steps even with KV occupancy under the watermarks; ``resume_io_error``
+  raises :class:`InjectedIOError` in the engine's resume tier-read — the
+  victim must re-queue or shed RETRYABLY, never serve zeroed KV (``site``
+  optionally pins the failure to one tier: ``host`` | ``nvme``).
 * replica-lifecycle sites (``serving/router.py`` + ``serving/fleet.py``,
   drilled by ``tools/elastic_drill.py``): ``replica_crash`` raises
   :class:`InjectedCrash` at the top of a replica worker loop — OUTSIDE the
@@ -95,6 +102,8 @@ class FaultSpec:
              "torn_checkpoint", "io_error",
              # serving sites (ContinuousBatcher hooks)
              "slow_decode", "decode_nan", "shed_storm", "cache_io_error",
+             # SLO-preemption sites (pause/resume through the KV tier)
+             "preempt_storm", "resume_io_error",
              # replica-lifecycle sites (Replica/FleetController hooks)
              "replica_crash", "slow_start", "weight_load_io_error")
 
@@ -244,6 +253,30 @@ class FaultInjector:
                 self._record(spec, "serving:shed")
                 return True
         return False
+
+    def preempt_forced(self) -> bool:
+        """True while a ``preempt_storm`` fault has occurrences left: the
+        batcher runs victim selection (the pause path) this step even with
+        KV occupancy under the watermarks — the drill lever for exercising
+        pause→resume cycles without actually saturating the pool."""
+        for spec in self.faults:
+            if spec.kind == "preempt_storm" and self._take(spec):
+                self._record(spec, "serving:preempt")
+                return True
+        return False
+
+    def on_resume_read(self, tier: str) -> None:
+        """Hook in the engine's resume tier-read (one call per parked
+        block, before its ``wait()``): a ``resume_io_error`` spec raises so
+        the resume must unwind — the victim re-queues or sheds retryably,
+        NEVER decodes over zero-filled KV. ``site`` pins the failure to
+        one tier (``host`` | ``nvme``); None fires at any tier."""
+        for spec in self.faults:
+            if spec.kind == "resume_io_error" \
+                    and spec.site in (None, tier) and self._take(spec):
+                self._record(spec, f"resume:{tier}")
+                raise InjectedIOError(
+                    f"injected resume tier-read failure ({tier})")
 
     # ---- replica-lifecycle faults -----------------------------------------
     def on_replica_loop(self, name: str) -> None:

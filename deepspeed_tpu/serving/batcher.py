@@ -56,7 +56,8 @@ from deepspeed_tpu.observability.events import get_bus
 from deepspeed_tpu.observability.trace import flight_dump
 from deepspeed_tpu.resilience.faults import InjectedIOError, get_injector
 from deepspeed_tpu.serving.manager import RequestManager
-from deepspeed_tpu.serving.request import DECODING, PREFILLING, ServeRequest
+from deepspeed_tpu.serving.request import (DECODING, PAUSED, PREFILLING,
+                                           TIERS, ServeRequest)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["STARTING", "READY", "DEGRADED", "DRAINING", "ContinuousBatcher"]
@@ -109,7 +110,13 @@ class ContinuousBatcher:
                 default_deadline_s=self.cfg.default_deadline_s,
                 retry_after_s=self.cfg.retry_after_s,
                 clock=clock, metrics=self.metrics,
-                max_done_history=self.cfg.max_done_history)
+                max_done_history=self.cfg.max_done_history,
+                default_tier=self.cfg.slo.default_tier,
+                retry_after_tier_factor=dict(self.cfg.slo.retry_after_factor))
+        # paused KV parks in the engine's tier store; size its host budget
+        # from the serving config before the first pause forces creation
+        if hasattr(self.engine, "pause_store_mb"):
+            self.engine.pause_store_mb = float(self.cfg.slo.pause_host_mb)
         # causal event bus (observability.tracing) — cached ref; the
         # singleton is mutated in place by configure_tracing
         self._ebus = get_bus()
@@ -145,8 +152,13 @@ class ContinuousBatcher:
             "prefix_hit_requests": 0, "prefix_hit_tokens": 0,
             "tier_hit_requests": 0, "tier_promoted_blocks": 0,
             "spec_rounds": 0, "spec_draft_tokens": 0,
-            "spec_accepted_tokens": 0,
+            "spec_accepted_tokens": 0, "resume_failures": 0,
         }
+        # uids paused during the CURRENT step: a pause must hold for at
+        # least one full step, or the same-step resume pass would undo the
+        # demote it just paid for (and re-arm the starvation guard through
+        # a pointless tier-store round-trip)
+        self._just_paused: set = set()
 
     @classmethod
     def from_deepspeed_config(cls, engine, config, monitor=None, **kw):
@@ -251,7 +263,8 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------
     # phases of one step
     # ------------------------------------------------------------------
-    def _shed_over_watermarks(self, forced: bool) -> None:
+    def _shed_over_watermarks(self, forced: bool,
+                              storm: bool = False) -> None:
         mgr = self.manager
         if forced:
             # shed_storm drill: drop the whole queue this step, retryably
@@ -261,6 +274,10 @@ class ContinuousBatcher:
         if overflow > 0:
             for req in mgr.queued_by_shed_order()[:overflow]:
                 mgr.shed(req, "queue_pressure")
+        slo = self.cfg.slo
+        if slo.enabled and slo.preempt:
+            self._preempt_over_watermarks(forced, storm)
+            return
         if forced or self.kv_occupancy > self.cfg.kv_high_watermark:
             # free real blocks: evict in-flight lowest-priority/newest until
             # under the low watermark, but never the last survivor — the
@@ -269,6 +286,106 @@ class ContinuousBatcher:
             while len(victims) > 1 \
                     and self.kv_occupancy > self.cfg.kv_low_watermark:
                 mgr.shed(victims.pop(0), "kv_pressure")
+
+    def _preempt_over_watermarks(self, forced: bool, storm: bool) -> None:
+        """SLO replacement for the kv_pressure shed: under block pressure
+        (or a ``preempt_storm`` drill), victims are PAUSED — their KV
+        demoted into the tier store through the engine — and only shed when
+        they cannot pause (no KV on device yet, store full, or the
+        starvation guard / ``max_pauses`` says no). Victim order is
+        :meth:`ServeRequest.preempt_key`: batch tier before throughput
+        before latency, no-deadline before deadlined, most-remaining-work
+        first. The last survivor is never preempted, and a ``preempt_storm``
+        with slack occupancy pauses exactly one victim per step without
+        shedding anyone — the drill forces the pause path, not data loss."""
+        over = forced or self.kv_occupancy > self.cfg.kv_high_watermark
+        if not (over or storm):
+            return
+        mgr = self.manager
+        victims = [r for r in mgr.active.values()
+                   if r.state in (PREFILLING, DECODING)]
+        victims.sort(key=ServeRequest.preempt_key)
+        must = 1 if storm else 0
+        while len(victims) > 1 and (
+                must > 0
+                or (over and self.kv_occupancy > self.cfg.kv_low_watermark)):
+            victim = victims.pop(0)
+            if self._try_pause(victim):
+                must = 0
+                continue
+            if storm and not over:
+                continue       # storm never sheds; try the next candidate
+            mgr.shed(victim, "kv_pressure")
+            must = 0
+
+    def _try_pause(self, req: ServeRequest) -> bool:
+        """Demote ``req``'s KV through the tier store and park it PAUSED.
+        False (caller falls back to shedding) when the starvation guard or
+        pause budget refuses, or the engine cannot extract/park the blocks —
+        in which case the engine guarantees no side effects."""
+        slo = self.cfg.slo
+        if not req.pause_allowed() or req.pause_count >= slo.max_pauses:
+            return False
+        t0 = self.clock()
+        if not self.engine.pause_request(req.uid):
+            return False
+        self.manager.pause(req)
+        self._just_paused.add(req.uid)
+        self.metrics.preemption(req.tier).inc()
+        if self._trace:
+            self.metrics.pause_ms.observe((self.clock() - t0) * 1e3)
+        return True
+
+    def _resume_paused(self) -> None:
+        """Rejoin paused requests when capacity allows — they are warm
+        capacity, not cold queue: their KV promotes back from the tier
+        store (no prefill recompute) under the same projection budget
+        admission charges new work. Latency tier first, earliest pause
+        first, up to ``slo.resume_max_per_step`` per step. A resume whose
+        demoted entries were lost (tier spill, injected IO error) is shed
+        retryably as ``resume_io_error`` — never silently zero-filled."""
+        slo = self.cfg.slo
+        if not (slo.enabled and slo.preempt):
+            return
+        mgr = self.manager
+        plist = mgr.paused()
+        if not plist:
+            return
+        budget = self.num_blocks * self.cfg.kv_high_watermark \
+            * self._capacity_factor()
+        proj = self._projected_blocks()
+        # nothing queued and nothing runnable: the pool is idle, so the
+        # budget gate must not strand the last paused requests forever
+        idle_pool = not mgr.queue and all(
+            r.state == PAUSED for r in mgr.active.values())
+        resumed = 0
+        for req in plist:
+            if resumed >= slo.resume_max_per_step:
+                break
+            if req.uid in self._just_paused:
+                continue       # paused THIS step; hold at least one step
+            full = self._blocks_for(req.total_token_demand)
+            if not idle_pool and proj + full > budget:
+                continue       # over budget now; later (smaller) may fit
+            if not self.engine.can_resume(req.uid):
+                continue       # no slot/blocks this step; stays parked
+            t0 = self.clock()
+            ok = self.engine.resume_request(req.uid)
+            # force the promote now so a lost/unreadable entry surfaces
+            # BEFORE the request rejoins the plan
+            lost = self.engine.flush_resumes()
+            if req.uid in lost:
+                self.counters["resume_failures"] += 1
+                mgr.shed(req, "resume_io_error")
+                continue
+            if not ok:
+                continue       # capacity race; still parked, retried later
+            mgr.resume_admit(req)
+            proj += full
+            resumed += 1
+            idle_pool = False
+            if self._trace:
+                self.metrics.resume_ms.observe((self.clock() - t0) * 1e3)
 
     def _projected_blocks(self) -> int:
         """Worst-case pool demand of everything already admitted: blocks
@@ -283,26 +400,58 @@ class ContinuousBatcher:
         # hide pinned KV from the budget and overcommit the pool
         proj = self.used_blocks - self.reclaimable_blocks
         for r in self.manager.active.values():
+            if r.state == PAUSED:
+                # parked: holds no pool blocks, and counting its comeback
+                # here would keep the HBM the pause just freed unusable —
+                # resume re-budgets it through _resume_paused instead
+                continue
             held = len(seqs[r.uid].blocks) if r.uid in seqs else 0
             proj += max(0, self._blocks_for(r.total_token_demand) - held)
         return proj
+
+    def _tier_projection(self) -> Dict[str, int]:
+        """Worst-case pool demand per SLO tier (paused requests excluded,
+        same as :meth:`_projected_blocks`) — the denominator the per-tier
+        admission budgets are checked against."""
+        out: Dict[str, int] = {}
+        for r in self.manager.active.values():
+            if r.state == PAUSED:
+                continue
+            out[r.tier] = out.get(r.tier, 0) \
+                + self._blocks_for(r.total_token_demand)
+        return out
 
     def _admit(self) -> None:
         mgr = self.manager
         budget = self.num_blocks * self.cfg.kv_high_watermark \
             * self._capacity_factor()
         proj = self._projected_blocks()
-        while mgr.queue and len(mgr.active) < self._max_active_eff():
-            req = mgr.queue[0]
+        slo = self.cfg.slo
+        slo_on = bool(slo.enabled)
+        tier_proj = self._tier_projection() if slo_on else {}
+        # snapshot: with tiers on, an over-budget tier's head WAITS without
+        # blocking requests from other tiers queued behind it
+        for req in list(mgr.queue):
+            if len(mgr.active) >= self._max_active_eff():
+                break
             # prefix-aware: only the UNCACHED share of the demand counts
             need = self._blocks_needed(req)
+            full = self._blocks_for(req.total_token_demand)
             if req.total_token_demand > self.engine.max_seq_len \
-                    or self._blocks_for(req.total_token_demand) \
+                    or full \
                     > self.num_blocks * self.cfg.kv_high_watermark:
                 # can never fit, at any load (the cache is transient, so
                 # oversize is judged on the full demand) — terminal
                 mgr.shed(req, "oversize", retryable=False)
                 continue
+            if slo_on:
+                frac = float(slo.budgets.get(req.tier, 1.0))
+                if frac < 1.0 \
+                        and tier_proj.get(req.tier, 0) + full \
+                        > frac * budget:
+                    # the tier is over its admission share: WAIT (never a
+                    # terminal shed) and let other tiers admit past it
+                    continue
             if proj + need > budget:
                 if not mgr.active:
                     # nothing in flight will ever free blocks for this head
@@ -313,6 +462,8 @@ class ContinuousBatcher:
                     continue
                 break          # FIFO head-of-line: don't starve big requests
             mgr.admit(req)
+            if slo_on:
+                tier_proj[req.tier] = tier_proj.get(req.tier, 0) + full
             if getattr(self.engine, "prefix_cache", None) is not None:
                 pc = self.engine.prefix_cache
                 promoted0 = pc.counters["promoted_blocks"]
@@ -385,11 +536,13 @@ class ContinuousBatcher:
             now = self.clock()
             if req.first_token_at is None:
                 req.first_token_at = now
-                self.metrics.ttft_ms.observe(
-                    (now - req.submitted_at) * 1e3)
+                v = (now - req.submitted_at) * 1e3
+                self.metrics.ttft_ms.observe(v)
+                self.metrics.ttft_tier(req.tier).observe(v)
             else:
-                self.metrics.tpot_ms.observe(
-                    (now - req.last_token_at) * 1e3)
+                v = (now - req.last_token_at) * 1e3
+                self.metrics.tpot_ms.observe(v)
+                self.metrics.tpot_tier(req.tier).observe(v)
             req.last_token_at = now
         if self.cfg.eos_token_id is not None \
                 and nxt == self.cfg.eos_token_id:
@@ -448,9 +601,15 @@ class ContinuousBatcher:
             self.begin_drain("SIGTERM")
         inj = get_injector()
         self.manager.expire()
+        self._just_paused.clear()
         if self.health != DRAINING:
-            self._shed_over_watermarks(forced=bool(inj) and inj.shed_forced())
+            self._shed_over_watermarks(
+                forced=bool(inj) and inj.shed_forced(),
+                storm=bool(inj) and inj.preempt_forced())
             self._admit()
+        # resumes run even while DRAINING: a paused request is in-flight
+        # work the drain must finish, not queue to shed
+        self._resume_paused()
         batch = self._plan()
         if not batch:
             self.counters["idle_steps"] += 1
@@ -680,8 +839,10 @@ class ContinuousBatcher:
         mx.set_health(self.health)
         mx.queue_depth.set(float(self.manager.queue_depth))
         mx.set_queue_depths(self.manager.queue_depth_by_priority())
+        mx.set_queue_depth_tiers(self.manager.queue_depth_by_tier())
         mx.active_requests.set(float(len(self.manager.active)))
         mx.kv_occupancy.set(float(self.kv_occupancy))
+        mx.paused_requests.set(float(len(self.manager.paused())))
 
     def _latency_pct(self, q: float) -> float:
         return float(self._step_window.percentile(q))
@@ -766,8 +927,12 @@ class ContinuousBatcher:
             "shed_reasons": dict(m.shed_reasons),
             "queue_depth": m.queue_depth,
             "queue_depth_by_priority": m.queue_depth_by_priority(),
+            "queue_depth_by_tier": m.queue_depth_by_tier(),
             "retry_after_s": round(m.current_retry_after(), 3),
+            "retry_after_by_tier": {
+                t: round(m.current_retry_after(t), 3) for t in TIERS},
             "active_requests": len(m.active),
+            "paused_requests": len(m.paused()),
             "kv": {"num_blocks": self.num_blocks,
                    "used_blocks": self.used_blocks,
                    "free_blocks": self.num_blocks - self.used_blocks,
@@ -801,10 +966,12 @@ class ContinuousBatcher:
                   ("serving/kv_occupancy", float(self.kv_occupancy), s),
                   ("serving/step_p50_ms", self._latency_pct(50), s),
                   ("serving/step_p99_ms", self._latency_pct(99), s)]
+        events.append(("serving/paused_requests",
+                       float(len(m.paused())), s))
         for k in ("submitted", "rejected", "admitted", "completed", "shed",
-                  "expired", "cancelled"):
+                  "expired", "cancelled", "paused", "resumed"):
             events.append((f"serving/{k}", float(m.counters[k]), s))
         for k in ("engine_steps", "step_failures", "decode_tokens",
-                  "prefill_tokens", "degraded_entries"):
+                  "prefill_tokens", "degraded_entries", "resume_failures"):
             events.append((f"serving/{k}", float(self.counters[k]), s))
         return events

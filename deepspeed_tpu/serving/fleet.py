@@ -17,9 +17,11 @@ routing and request bookkeeping; the controller owns replica LIFECYCLE:
   resolvable the whole time.
 
 * **autoscaling** — scale decisions ride the signals the serving stack
-  already exports: pool queue depth per routable replica, the shed-rate
-  delta between polls, and the pool-max ``current_retry_after()``
-  watermark. Hysteresis on both edges (``scale_up_polls`` consecutive
+  already exports: pool queue depth per routable replica (latency +
+  throughput SLO tiers only — a batch-tier backlog is deferred-by-design
+  work and neither triggers scale-up nor holds off scale-down), the
+  shed-rate delta between polls, and the pool-max
+  ``current_retry_after()`` watermark. Hysteresis on both edges (``scale_up_polls`` consecutive
   pressured polls to grow, ``scale_down_idle_polls`` consecutive idle
   polls to shrink) keeps a bursty queue from flapping the pool. Scale-up
   is a fast cold start (warm when a
@@ -162,6 +164,19 @@ class FleetController:
         self.m["ready"].set(len(ready))
         self.m["pool"].set(len(reps))
         queue_depth = sum(r.stats["queue_depth"] for r in routable)
+        # scale pressure counts only the latency-sensitive tiers: a deep
+        # batch-tier backlog is deferred-by-design work and must neither
+        # trigger scale-up nor hold off scale-down. Replicas that predate
+        # the tier breakdown (no queue_depth_by_tier in stats) fall back
+        # to their total depth — unknown load is treated as urgent
+        urgent_depth = 0
+        for r in routable:
+            by_tier = r.stats.get("queue_depth_by_tier")
+            if by_tier is None:
+                urgent_depth += r.stats["queue_depth"]
+            else:
+                urgent_depth += sum(d for t, d in by_tier.items()
+                                    if t != "batch")
         active = sum(r.stats["active"] for r in routable)
         retry_hint = max((r.stats["retry_after"] for r in routable),
                         default=0.0)
@@ -174,10 +189,10 @@ class FleetController:
             seen[k] = cur
         self._shed_seen = seen
         pressured = bool(routable) and (
-            queue_depth > cfg.scale_up_queue_per_replica * len(routable)
+            urgent_depth > cfg.scale_up_queue_per_replica * len(routable)
             or shed_delta > 0
             or retry_hint >= cfg.scale_up_retry_after_s)
-        idle = bool(routable) and queue_depth == 0 and active == 0
+        idle = bool(routable) and urgent_depth == 0 and active == 0
         self._up_streak = self._up_streak + 1 if pressured else 0
         self._idle_streak = self._idle_streak + 1 if idle else 0
         if (self._up_streak >= cfg.scale_up_polls

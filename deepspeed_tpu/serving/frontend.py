@@ -185,6 +185,10 @@ class ServingFrontend:
         extra = ({} if not bus.enabled else
                  {"trace_id": trace_id if trace_id is not None
                   else SAMPLED_OUT})
+        if preq.tier is not None:
+            # only when the client chose one: an absent tier keeps the
+            # pre-SLO submit contract and the backend's configured default
+            extra["tier"] = preq.tier
         try:
             uid = self.backend.submit(
                 preq.prompt, max_new_tokens=preq.max_new_tokens,

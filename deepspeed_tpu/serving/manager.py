@@ -23,8 +23,10 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
 from deepspeed_tpu.serving.request import (CANCELLED, COMPLETED, DECODING,
-                                           EXPIRED, PREFILLING, QUEUED, SHED,
-                                           ServeRequest, ShedError, as_prompt)
+                                           EXPIRED, PAUSED, PREFILLING,
+                                           QUEUED, SHED, TIER_THROUGHPUT,
+                                           TIERS, ServeRequest, ShedError,
+                                           as_prompt)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["RequestManager"]
@@ -42,10 +44,18 @@ class RequestManager:
                  retry_after_s: float = 1.0,
                  release_fn: Optional[Callable[[Sequence[int]], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 metrics=None, max_done_history: int = 65536):
+                 metrics=None, max_done_history: int = 65536,
+                 default_tier: str = TIER_THROUGHPUT,
+                 retry_after_tier_factor: Optional[Dict[str, float]] = None):
         self.max_queue_depth = int(max_queue_depth)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_deadline_s = default_deadline_s
+        self.default_tier = (default_tier if default_tier in TIERS
+                             else TIER_THROUGHPUT)
+        # per-tier Retry-After multiplier (serving.slo.retry_after_factor):
+        # batch-tier 429s are told to back off harder than latency-tier
+        # ones under the same pressure — spot traffic yields first
+        self.retry_after_tier_factor = dict(retry_after_tier_factor or {})
         # BASE back-off hint; what a ShedError actually carries is
         # current_retry_after() — this base scaled by live pressure
         self.retry_after_s = float(retry_after_s)
@@ -82,7 +92,8 @@ class RequestManager:
         self._closed_reason: Optional[str] = None
         self.counters: Dict[str, int] = {
             "submitted": 0, "rejected": 0, "admitted": 0, "completed": 0,
-            "shed": 0, "expired": 0, "cancelled": 0,
+            "shed": 0, "expired": 0, "cancelled": 0, "paused": 0,
+            "resumed": 0,
         }
         self.shed_reasons: Dict[str, int] = {}
 
@@ -91,10 +102,16 @@ class RequestManager:
     # ------------------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0, trace_id: Optional[int] = None) -> int:
+               priority: int = 0, tier: Optional[str] = None,
+               trace_id: Optional[int] = None) -> int:
         """Enqueue a request; returns its uid. Raises :class:`ShedError`
         (``reason=queue_full`` or ``draining``, both retryable) instead of
-        growing the queue without bound — admission control IS the refusal."""
+        growing the queue without bound — admission control IS the refusal.
+        ``tier`` (latency|throughput|batch, default ``default_tier``) is
+        the request's SLO class; the Retry-After a refusal carries is
+        scaled by the tier's back-off factor."""
+        if tier is None or tier not in TIERS:
+            tier = self.default_tier
         self.counters["submitted"] += 1
         if self._closed_reason is not None:
             self.counters["rejected"] += 1
@@ -102,7 +119,7 @@ class RequestManager:
             if self.metrics is not None:
                 self.metrics.rejected("draining").inc()
             raise ShedError("draining", retryable=True,
-                            retry_after_s=self.current_retry_after(),
+                            retry_after_s=self.current_retry_after(tier),
                             detail=self._closed_reason)
         if len(self.queue) >= self.max_queue_depth:
             self.counters["rejected"] += 1
@@ -110,7 +127,7 @@ class RequestManager:
             if self.metrics is not None:
                 self.metrics.rejected("queue_full").inc()
             raise ShedError("queue_full", retryable=True,
-                            retry_after_s=self.current_retry_after(),
+                            retry_after_s=self.current_retry_after(tier),
                             detail=f"depth {len(self.queue)} >= "
                                    f"{self.max_queue_depth}")
         self._pressure.append(0.0)
@@ -127,7 +144,7 @@ class RequestManager:
             max_new_tokens=int(max_new_tokens
                                if max_new_tokens is not None
                                else self.default_max_new_tokens),
-            priority=int(priority),
+            priority=int(priority), tier=tier,
             deadline=None if deadline_s is None else now + float(deadline_s),
             submitted_at=now, trace_id=trace_id)
         self._next_uid += 1
@@ -138,24 +155,30 @@ class RequestManager:
             # stamps the same (cat="request", id=trace_id) track
             bus.async_begin("request", "request", req.trace_id, args={
                 "subsys": "serving", "what": "submit", "uid": req.uid,
-                "prompt_tokens": req.prompt_len, "priority": req.priority})
+                "prompt_tokens": req.prompt_len, "priority": req.priority,
+                "tier": req.tier})
         return req.uid
 
     def close(self, reason: str = "draining") -> None:
         """Stop admitting new requests (graceful-drain entry)."""
         self._closed_reason = reason
 
-    def current_retry_after(self) -> float:
+    def current_retry_after(self, tier: Optional[str] = None) -> float:
         """Load-aware back-off hint: the configured base scaled by queue
         fullness and the recent shed/reject rate, so the ``Retry-After`` a
         429 carries actually reflects pressure — an idle server says
         "come back in ``retry_after_s``", a saturated one up to ~4x that.
-        Deterministic (count-based windows, no wall clock) so drills can
-        assert on it."""
+        ``tier`` additionally applies the per-tier back-off factor (batch
+        4x latency by default) so spot traffic is told to yield hardest
+        under the same pressure. Deterministic (count-based windows, no
+        wall clock) so drills can assert on it."""
         qfrac = min(1.0, len(self.queue) / max(1, self.max_queue_depth))
         p = self._pressure
         sfrac = (sum(p) / len(p)) if p else 0.0
-        return self.retry_after_s * (1.0 + qfrac + 2.0 * sfrac)
+        base = self.retry_after_s * (1.0 + qfrac + 2.0 * sfrac)
+        if tier is not None:
+            base *= float(self.retry_after_tier_factor.get(tier, 1.0))
+        return base
 
     @property
     def closed(self) -> bool:
@@ -231,7 +254,8 @@ class RequestManager:
              ) -> None:
         self._pressure.append(1.0)
         req.error = ShedError(reason, uid=req.uid, retryable=retryable,
-                              retry_after_s=self.current_retry_after())
+                              retry_after_s=self.current_retry_after(
+                                  req.tier))
         req.finish_reason = reason
         self._finish(req, SHED)
         self.counters["shed"] += 1
@@ -242,6 +266,50 @@ class RequestManager:
         logger.warning(f"serving: shed uid={req.uid} ({reason}, "
                        f"prefilled={req.prefilled}/{req.prompt_len}, "
                        f"generated={len(req.generated)})")
+
+    def pause(self, req: ServeRequest) -> None:
+        """PREEMPT an in-flight request: mark it PAUSED. The uid STAYS in
+        ``active`` — a paused request is live (the router's liveness probes
+        and ``resolve()`` must keep answering for it); it simply stops
+        appearing in the decode/prefill plans until :meth:`resume_admit`.
+        KV demotion is the engine's job (``pause_request``) and happens
+        before this transition; the manager only keeps the ledger."""
+        req.state = PAUSED
+        req.pause_count += 1
+        req.progress_at_last_pause = req.progress
+        req.paused_at = self.clock()
+        self.counters["paused"] += 1
+        if req.trace_id is not None and self._ebus.enabled:
+            self._ebus.async_instant("request", "request", req.trace_id,
+                                     args={"subsys": "serving",
+                                           "what": "pause", "uid": req.uid,
+                                           "tier": req.tier,
+                                           "progress": req.progress})
+
+    def resume_admit(self, req: ServeRequest) -> None:
+        """Un-pause: the engine restored the request's KV (promote queued
+        under the fence), so it rejoins the decode/prefill plans. State
+        returns to DECODING when the prompt is fully in KV, else
+        PREFILLING (a request paused mid-chunked-prefill)."""
+        req.state = (DECODING if req.prefilled >= req.prompt_len
+                     else PREFILLING)
+        req.paused_at = None
+        self.counters["resumed"] += 1
+        if req.trace_id is not None and self._ebus.enabled:
+            self._ebus.async_instant("request", "request", req.trace_id,
+                                     args={"subsys": "serving",
+                                           "what": "resume", "uid": req.uid,
+                                           "tier": req.tier,
+                                           "pauses": req.pause_count})
+
+    def paused(self) -> List[ServeRequest]:
+        """Paused requests in resume order: latency tier first, earliest
+        pause first — the request that has waited longest in the most
+        latency-sensitive tier gets the freed capacity."""
+        out = [r for r in self.active.values() if r.state == PAUSED]
+        out.sort(key=lambda r: (TIERS.index(r.tier) if r.tier in TIERS
+                                else len(TIERS), r.paused_at or 0.0))
+        return out
 
     def cancel(self, uid: int, reason: str = "cancelled") -> bool:
         """User-initiated cancellation; True if the request was still live."""
@@ -327,6 +395,14 @@ class RequestManager:
             out[r.priority] = out.get(r.priority, 0) + 1
         return out
 
+    def queue_depth_by_tier(self) -> Dict[str, int]:
+        """Queued requests broken down by SLO tier — the fleet autoscaler's
+        signal (batch-tier backlog alone must not trigger scale-up)."""
+        out: Dict[str, int] = {}
+        for r in self.queue:
+            out[r.tier] = out.get(r.tier, 0) + 1
+        return out
+
     def queued_by_shed_order(self) -> List[ServeRequest]:
         return sorted(self.queue, key=ServeRequest.shed_key)
 
@@ -342,7 +418,10 @@ class RequestManager:
     def report(self) -> Dict:
         return {"queue_depth": self.queue_depth,
                 "queue_depth_by_priority": self.queue_depth_by_priority(),
+                "queue_depth_by_tier": self.queue_depth_by_tier(),
                 "active": len(self.active),
+                "paused": sum(1 for r in self.active.values()
+                              if r.state == PAUSED),
                 "closed": self.closed,
                 "retry_after_s": round(self.current_retry_after(), 3),
                 "counters": dict(self.counters),

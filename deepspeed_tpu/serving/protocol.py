@@ -7,8 +7,13 @@ same source:
 
 * **request schema** — ``POST /v1/generate`` JSON body:
   ``{"prompt": [int token ids], "max_new_tokens"?, "deadline_s"?,
-  "priority"?, "stream"?}``. Prompts are token ids (the engine has no
-  tokenizer); a string prompt is a 400, an over-long one a 413.
+  "priority"?, "tier"?, "stream"?}``. Prompts are token ids (the engine
+  has no tokenizer); a string prompt is a 400, an over-long one a 413.
+  ``tier`` is the SLO class — ``"latency"`` / ``"throughput"`` /
+  ``"batch"`` — driving per-tier admission budgets, preemption victim
+  order, and tier-scaled ``Retry-After``; anything else is a 400
+  ``invalid_tier``, and an absent tier takes the replica's configured
+  default.
 * **tenant priority** — ``x-api-key`` maps through the configured
   ``serving.frontend.api_keys`` table onto the RequestManager's integer
   admission priorities; ``x-priority`` (or body ``priority``) is honored
@@ -35,7 +40,7 @@ import json
 import math
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from deepspeed_tpu.serving.request import (COMPLETED, EXPIRED, SHED,
+from deepspeed_tpu.serving.request import (COMPLETED, EXPIRED, SHED, TIERS,
                                            ServeRequest, ShedError)
 
 __all__ = ["GENERATE_PATH", "STATE_PATH", "API_KEY_HEADER",
@@ -73,6 +78,8 @@ class GenerateRequest:
     max_new_tokens: Optional[int] = None
     deadline_s: Optional[float] = None
     priority: int = 0
+    #: SLO tier (latency/throughput/batch); None = backend default
+    tier: Optional[str] = None
     stream: bool = False
 
 
@@ -144,11 +151,17 @@ def parse_generate_request(raw: bytes, headers, cfg) -> GenerateRequest:
             raise ProtocolError(400, "invalid_deadline",
                                 "deadline_s must be a positive number")
         deadline = float(deadline)
+    tier = body.get("tier")
+    if tier is not None and tier not in TIERS:
+        raise ProtocolError(400, "invalid_tier",
+                            f"tier must be one of {list(TIERS)}, "
+                            f"got {tier!r}")
     return GenerateRequest(
         prompt=[int(t) for t in prompt],
         max_new_tokens=max_new,
         deadline_s=deadline,
         priority=resolve_priority(headers, body.get("priority"), cfg),
+        tier=tier,
         stream=bool(body.get("stream", False)))
 
 

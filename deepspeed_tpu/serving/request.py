@@ -6,8 +6,22 @@ silently lost* — is checkable from the outside (``tools/serve_drill.py``
 asserts it after every drill):
 
     QUEUED ──admit──▶ PREFILLING ──▶ DECODING ──▶ COMPLETED
+       │                  │         ▲      │
+       │                  └─▶ PAUSED ◀─────┤
        │                  │              │
        └──────── shed / expire / cancel ─┴──▶ SHED | EXPIRED | CANCELLED
+
+PAUSED is the preemption state: the request's KV blocks have been demoted
+through the tier store and its HBM freed, but it is still live, still
+resolvable, and resumes (promote + continue decoding, bit-identical greedy
+tokens) when capacity returns. A paused request stays in the manager's
+``active`` ledger so it is never "lost" to the router's liveness probes.
+
+Every request carries an SLO **tier** — ``latency`` (chat), ``throughput``
+(agents), ``batch`` (offline / spot) — that drives admission budgets,
+victim selection (batch pays for latency bursts), and tier-labeled SLO
+metrics. Tier is orthogonal to ``priority``: priority orders sheds *within*
+a tier; tier decides who gets paused first.
 
 ``ShedError`` is the typed backpressure signal: it says *the system chose to
 drop this request because of load*, distinguishes retryable overload (queue
@@ -23,19 +37,29 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["QUEUED", "PREFILLING", "DECODING", "COMPLETED", "SHED",
-           "EXPIRED", "CANCELLED", "TERMINAL_STATES", "ShedError",
+__all__ = ["QUEUED", "PREFILLING", "DECODING", "PAUSED", "COMPLETED", "SHED",
+           "EXPIRED", "CANCELLED", "TERMINAL_STATES", "TIER_LATENCY",
+           "TIER_THROUGHPUT", "TIER_BATCH", "TIERS", "ShedError",
            "ServeRequest"]
 
 QUEUED = "queued"
 PREFILLING = "prefilling"
 DECODING = "decoding"
+PAUSED = "paused"
 COMPLETED = "completed"
 SHED = "shed"
 EXPIRED = "expired"
 CANCELLED = "cancelled"
 
 TERMINAL_STATES = (COMPLETED, SHED, EXPIRED, CANCELLED)
+
+# SLO tiers, ordered most- to least-latency-sensitive. Victim selection
+# walks this order BACKWARDS (batch pays first); admission budgets and the
+# fleet's autoscaling signals key off the same strings.
+TIER_LATENCY = "latency"
+TIER_THROUGHPUT = "throughput"
+TIER_BATCH = "batch"
+TIERS = (TIER_LATENCY, TIER_THROUGHPUT, TIER_BATCH)
 
 
 class ShedError(RuntimeError):
@@ -74,6 +98,7 @@ class ServeRequest:
     prompt: np.ndarray                 # int32 [prompt_len]
     max_new_tokens: int
     priority: int = 0                  # higher = shed later
+    tier: str = TIER_THROUGHPUT        # SLO tier: latency|throughput|batch
     deadline: Optional[float] = None   # absolute clock() time, None = none
     submitted_at: float = 0.0
     state: str = QUEUED
@@ -88,6 +113,12 @@ class ServeRequest:
     # causal event-bus track id (observability.tracing); None = tracing
     # off or this request sampled out — emit nothing for it
     trace_id: Optional[int] = None
+    # preemption bookkeeping (see PAUSED above): the starvation guard
+    # refuses to pause a request again before its progress (prefilled +
+    # generated tokens) has advanced past where the last pause left it
+    pause_count: int = 0
+    progress_at_last_pause: int = -1
+    paused_at: Optional[float] = None
     # terminal bookkeeping
     finish_reason: str = ""            # length | eos | shed slug | expired
     error: Optional[ShedError] = None
@@ -110,11 +141,42 @@ class ServeRequest:
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
 
+    @property
+    def progress(self) -> int:
+        """Tokens of work materialised in KV so far (prefilled prompt +
+        generated) — the starvation guard's monotonic progress measure."""
+        return self.prefilled + len(self.generated)
+
+    @property
+    def remaining_tokens(self) -> int:
+        """Worst-case tokens still to produce — victim selection prefers
+        the request with the MOST remaining work (its pause wastes the
+        least already-spent compute per freed block)."""
+        return max(0, self.max_new_tokens - len(self.generated))
+
     def shed_key(self) -> tuple:
         """Sort key for victim selection: lowest priority first, then newest
         (LIFO within a priority class — the request that waited longest keeps
         its place)."""
         return (self.priority, -self.submitted_at)
+
+    def preempt_key(self) -> tuple:
+        """Sort key for PAUSE victim selection (ascending = pause first):
+        batch tier before throughput before latency, deadline-free requests
+        before deadlined ones (a pause must not convert into an expiry),
+        most-remaining-work first, then the plain shed order."""
+        try:
+            tier_rank = TIERS.index(self.tier)
+        except ValueError:
+            tier_rank = len(TIERS)
+        return (-tier_rank, self.deadline is not None,
+                -self.remaining_tokens, self.shed_key())
+
+    def pause_allowed(self) -> bool:
+        """Starvation guard: a request may be paused again only after it
+        advanced past the progress point of its previous pause."""
+        return self.pause_count == 0 \
+            or self.progress > self.progress_at_last_pause
 
     def span(self) -> dict:
         """The request's trace: admit → queue-wait → TTFT → per-token decode
@@ -126,7 +188,8 @@ class ServeRequest:
         decode_ms = ms(self.first_token_at, self.last_token_at)
         return {
             "uid": self.uid, "state": self.state,
-            "trace_id": self.trace_id,
+            "trace_id": self.trace_id, "tier": self.tier,
+            "pauses": self.pause_count,
             "finish_reason": self.finish_reason or None,
             "prompt_tokens": self.prompt_len,
             "generated_tokens": len(self.generated),

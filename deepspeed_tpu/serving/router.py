@@ -206,6 +206,7 @@ class Replica:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None, priority: int = 0,
+               tier: Optional[str] = None,
                events: Optional["queue.Queue"] = None,
                trace_id: Optional[int] = None) -> int:
         """Submit through the worker; returns the batcher uid. Token/end
@@ -213,11 +214,12 @@ class Replica:
         starting before the first step that could touch it — no token is
         ever generated unobserved. ``trace_id`` rides through to the
         manager so the request keeps ONE causal track across the
-        frontend/router/batcher hop (and across migrations)."""
+        frontend/router/batcher hop (and across migrations). ``tier`` is
+        the SLO class (None = the batcher's configured default)."""
         return self._command("submit", dict(
             prompt=prompt, max_new_tokens=max_new_tokens,
-            deadline_s=deadline_s, priority=priority, events=events,
-            trace_id=trace_id))
+            deadline_s=deadline_s, priority=priority, tier=tier,
+            events=events, trace_id=trace_id))
 
     def cancel(self, uid: int) -> bool:
         return self._command("cancel", uid)
@@ -413,6 +415,9 @@ class Replica:
             "beat": time.monotonic(),
             "retry_after": m.current_retry_after(),
             "sheds": m.counters["shed"] + m.counters["rejected"],
+            # per-SLO-tier backlog: the autoscaler's pressure signal
+            # (batch-tier depth alone must not scale the fleet up)
+            "queue_depth_by_tier": m.queue_depth_by_tier(),
         }
 
 
@@ -516,6 +521,7 @@ class ReplicaRouter:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None, priority: int = 0,
+               tier: Optional[str] = None,
                events: Optional["queue.Queue"] = None,
                trace_id: Optional[int] = None,
                _exclude=(), _ruid: Optional[int] = None) -> int:
@@ -534,7 +540,8 @@ class ReplicaRouter:
             try:
                 uid = rep.submit(prompt, max_new_tokens=max_new_tokens,
                                  deadline_s=deadline_s, priority=priority,
-                                 events=events, trace_id=trace_id)
+                                 tier=tier, events=events,
+                                 trace_id=trace_id)
             except ShedError as e:
                 if not e.retryable:
                     raise            # oversize etc: no sibling can help
@@ -694,7 +701,7 @@ class ReplicaRouter:
                 new_ruid = self.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
                     deadline_s=remaining, priority=req.priority,
-                    events=events, trace_id=mig_trace,
+                    tier=req.tier, events=events, trace_id=mig_trace,
                     _exclude=(name,),
                     _ruid=None if ruid is None else ruid)
                 migrated += 1

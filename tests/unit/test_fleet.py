@@ -306,6 +306,80 @@ class TestRouterElasticity:
         assert len(router._retired) == router._max_retired
 
 
+class _FakeRep:
+    """Just enough replica surface for FleetController._autoscale."""
+
+    def __init__(self, name, qdepth=0, by_tier=None, active=0):
+        self.name = name
+        self.incarnation = 0
+        self.routable = True
+        self.alive = True
+        self.stats = {"health": "ready", "queue_depth": qdepth,
+                      "active": active, "retry_after": 0.0, "sheds": 0,
+                      "drained": False, "beat": time.monotonic()}
+        if by_tier is not None:
+            self.stats["queue_depth_by_tier"] = by_tier
+
+
+class _FakeRouter:
+    def __init__(self, reps):
+        self.replicas = {r.name: r for r in reps}
+
+    def _snapshot(self):
+        return list(self.replicas.values())
+
+
+@pytest.mark.elastic
+@pytest.mark.slo
+class TestAutoscalerTierAwareness:
+    """Satellite: the autoscaler reads per-tier queue depth — batch-tier
+    backlog alone must neither trigger scale-up nor hold off scale-down;
+    replicas without the breakdown fall back to total depth."""
+
+    def _controller(self, reps):
+        from deepspeed_tpu.config.config import FleetConfig
+        from deepspeed_tpu.observability import MetricsRegistry
+        from deepspeed_tpu.serving.fleet import FleetController
+
+        ctl = FleetController(
+            _FakeRouter(reps), lambda name: None,
+            config=FleetConfig(scale_up_polls=1, scale_down_idle_polls=1,
+                               scale_up_queue_per_replica=4,
+                               min_replicas=1, max_replicas=8),
+            registry=MetricsRegistry())
+        ctl._calls = []
+        ctl.scale_up = lambda: ctl._calls.append("up") or "rX"
+        ctl.scale_down = lambda: ctl._calls.append("down") or "r0"
+        return ctl
+
+    def test_batch_backlog_alone_scales_down_not_up(self):
+        reps = [_FakeRep("r0", qdepth=50, by_tier={"batch": 50}),
+                _FakeRep("r1", qdepth=0, by_tier={})]
+        ctl = self._controller(reps)
+        actions = {"scaled_up": None, "scaled_down": None}
+        ctl._autoscale(actions)
+        # a deep batch backlog is deferred-by-design work: the pool is
+        # IDLE for scaling purposes, so it shrinks instead of growing
+        assert ctl._calls == ["down"]
+
+    def test_latency_backlog_scales_up(self):
+        reps = [_FakeRep("r0", qdepth=50,
+                         by_tier={"latency": 40, "batch": 10}),
+                _FakeRep("r1", qdepth=0, by_tier={})]
+        ctl = self._controller(reps)
+        actions = {"scaled_up": None, "scaled_down": None}
+        ctl._autoscale(actions)
+        assert ctl._calls == ["up"]
+
+    def test_missing_breakdown_falls_back_to_total(self):
+        # pre-tier replicas: unknown load is treated as urgent
+        reps = [_FakeRep("r0", qdepth=50), _FakeRep("r1", qdepth=0)]
+        ctl = self._controller(reps)
+        actions = {"scaled_up": None, "scaled_down": None}
+        ctl._autoscale(actions)
+        assert ctl._calls == ["up"]
+
+
 class _StubBatcher:
     """The minimal batcher surface Replica touches without a worker."""
 

@@ -106,7 +106,7 @@ class TestRequestManager:
         assert released == [[u_done], [u_active]]
         assert mgr.counters == {"submitted": 3, "rejected": 0, "admitted": 2,
                                 "completed": 1, "shed": 1, "expired": 1,
-                                "cancelled": 0}
+                                "cancelled": 0, "paused": 0, "resumed": 0}
 
     def test_shed_order_is_lowest_priority_then_newest(self):
         now = [0.0]
@@ -317,6 +317,223 @@ def test_prefix_aware_admission_admits_mostly_cached_request():
 
 
 # ---------------------------------------------------------------------------
+# SLO tiers + preemptible requests (pause/resume through the KV tier store)
+# ---------------------------------------------------------------------------
+
+def _slo_batcher(**serving):
+    """fp32 engine (bit-identical greedy across pause/resume) + a batcher
+    with the SLO block enabled."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+
+    eng = InferenceEngineV2(
+        TransformerLM(get_preset("tiny", dtype="float32")),
+        max_sequences=8, max_seq_len=128, block_size=16)
+    cfg = ServingConfig(**{
+        "prefill_chunk": 32, "default_max_new_tokens": 8,
+        "slo": {"enabled": True, "preempt": True}, **serving})
+    return ContinuousBatcher(eng, cfg)
+
+
+@pytest.mark.slo
+class TestSLOPreemption:
+    def test_pause_resume_greedy_bit_identical_fp32(self):
+        """Tentpole invariant: pause -> demote through the tier store ->
+        promote -> resume reproduces the EXACT greedy token sequence of an
+        unpreempted run (fp32; KV bytes round-trip unquantized)."""
+        b = _slo_batcher()
+        rng = np.random.default_rng(7)
+        prompt = list(rng.integers(0, 250, 40))
+        base_uid = b.submit(prompt, max_new_tokens=8, tier="batch")
+        b.pump(max_steps=50)
+        base = list(b.manager.result(base_uid).generated)
+        assert len(base) == 8
+
+        uid = b.submit(prompt, max_new_tokens=8, tier="batch")
+        for _ in range(4):
+            b.step()                       # prefill + a few decode tokens
+        req = b.manager.active[uid]
+        mid = len(req.generated)
+        assert 0 < mid < 8                 # genuinely mid-decode
+        assert b.engine.pause_request(uid)
+        b.manager.pause(req)
+        # demoted: no device blocks for the uid, entries parked in the store
+        assert uid not in b.engine.state.sequences
+        assert b.engine.is_paused(uid)
+        assert b.engine.paused_blocks(uid) > 0
+        b.pump(max_steps=60)               # _resume_paused brings it back
+        res = b.manager.result(uid)
+        assert b.manager.resolve(uid) == COMPLETED
+        assert list(res.generated) == base  # bit-identical greedy
+        assert res.pause_count == 1
+        alloc = b.engine.state.allocator
+        assert alloc.free_blocks == alloc.num_blocks
+        assert b.engine._tier_store.entries() == 0   # no parked leftovers
+        assert b.manager.counters["paused"] == 1
+        assert b.manager.counters["resumed"] == 1
+        b.engine.close()
+
+    def test_preempt_mid_chunked_prefill_releases_everything(self):
+        """A victim caught MID-chunked-prefill pauses without leaking a
+        block or a slot, resumes into PREFILLING, and still matches the
+        unpreempted greedy output."""
+        b = _slo_batcher(default_max_new_tokens=4)
+        rng = np.random.default_rng(3)
+        prompt = list(rng.integers(0, 250, 96))    # 3 chunks of 32
+        base_uid = b.submit(prompt, tier="batch")
+        b.pump(max_steps=40)
+        base = list(b.manager.result(base_uid).generated)
+
+        alloc = b.engine.state.allocator
+        free0 = alloc.free_blocks
+        uid = b.submit(prompt, tier="batch")
+        b.step()                                   # exactly one chunk in KV
+        req = b.manager.active[uid]
+        assert req.state == "prefilling" and req.prefilled == 32
+        assert b.engine.pause_request(uid)
+        b.manager.pause(req)
+        # the device side is fully released while paused
+        assert uid not in b.engine.state.sequences
+        assert alloc.free_blocks == free0
+        b.pump(max_steps=60)
+        assert b.manager.resolve(uid) == COMPLETED
+        assert list(b.manager.result(uid).generated) == base
+        assert alloc.free_blocks == alloc.num_blocks
+        assert b.engine._tier_store.entries() == 0
+        b.engine.close()
+
+    def test_double_preempt_starvation_guard(self):
+        """A request that was preempted may not be preempted again before
+        it makes progress — two back-to-back ``preempt_storm`` steps pause
+        it once, and only post-resume progress re-arms the guard."""
+        from deepspeed_tpu.resilience import FaultInjector, set_injector
+
+        b = _slo_batcher()
+        try:
+            rng = np.random.default_rng(5)
+            victim = b.submit(list(rng.integers(0, 250, 40)),
+                              max_new_tokens=8, tier="batch")
+            other = b.submit(list(rng.integers(0, 250, 40)),
+                             max_new_tokens=8, tier="latency")
+            for _ in range(3):
+                b.step()
+            req = b.manager.active[victim]
+            assert req.pause_allowed()             # never paused yet
+            set_injector(FaultInjector([{"kind": "preempt_storm",
+                                         "times": 2}]))
+            b.step()                               # storm #1: pauses victim
+            assert b.manager.counters["paused"] == 1
+            assert req.pause_count == 1
+            assert not req.pause_allowed()         # no progress since pause
+            b.step()                               # storm #2: guard holds
+            assert b.manager.counters["paused"] == 1   # NOT paused again
+            # nobody was shed by the storms — preemption is not data loss
+            assert b.manager.counters["shed"] == 0
+            set_injector(None)
+            b.pump(max_steps=80)
+            assert b.manager.resolve(victim) == COMPLETED
+            assert b.manager.resolve(other) == COMPLETED
+            # once it decoded past the pause point the guard re-arms
+            assert b.manager.result(victim).progress \
+                > b.manager.result(victim).progress_at_last_pause
+        finally:
+            set_injector(None)
+            b.engine.close()
+
+    def test_resume_io_error_sheds_retryably_no_zero_fill(self):
+        """Lost/unreadable demoted entries surface as a retryable
+        ``resume_io_error`` shed — never a silent zero-filled KV resume —
+        and the pool is fully restored."""
+        from deepspeed_tpu.resilience import FaultInjector, set_injector
+
+        b = _slo_batcher()
+        try:
+            rng = np.random.default_rng(11)
+            uid = b.submit(list(rng.integers(0, 250, 40)),
+                           max_new_tokens=8, tier="batch")
+            for _ in range(3):
+                b.step()
+            assert b.engine.pause_request(uid)
+            b.manager.pause(b.manager.active[uid])
+            set_injector(FaultInjector([{"kind": "resume_io_error",
+                                         "times": 8}]))
+            b.pump(max_steps=20)
+            req = b.manager.result(uid)
+            assert b.manager.resolve(uid) == SHED
+            assert req.error.reason == "resume_io_error"
+            assert req.error.retryable
+            assert b.counters["resume_failures"] >= 1
+            alloc = b.engine.state.allocator
+            assert alloc.free_blocks == alloc.num_blocks
+            assert not b.engine.state.sequences
+            assert b.engine._tier_store.entries() == 0
+        finally:
+            set_injector(None)
+            b.engine.close()
+
+    def test_tier_flows_submit_to_request_and_retry_after(self):
+        """Satellite: tiers flow through submit; unknown/absent tiers take
+        the configured default; the 429 Retry-After hint scales by tier —
+        batch backs off harder than latency."""
+        mgr = RequestManager(retry_after_s=1.0, default_tier="throughput",
+                             retry_after_tier_factor={"batch": 4.0})
+        u_lat = mgr.submit([1, 2], tier="latency")
+        u_def = mgr.submit([1, 2])
+        u_bad = mgr.submit([1, 2], tier="hyperspeed")
+        assert mgr.result(u_lat).tier == "latency"
+        assert mgr.result(u_def).tier == "throughput"
+        assert mgr.result(u_bad).tier == "throughput"   # unknown -> default
+        assert mgr.current_retry_after("batch") \
+            == 4.0 * mgr.current_retry_after("latency")
+        assert mgr.queue_depth_by_tier() == {"latency": 1, "throughput": 2}
+
+    def test_per_tier_admission_budget_waits_never_sheds(self):
+        """A tier over its admission budget WAITS while other tiers admit
+        past its queued head; when capacity frees it completes — the budget
+        is backpressure, not a shed."""
+        b = _slo_batcher(
+            default_max_new_tokens=4,
+            slo={"enabled": True, "preempt": True,
+                 "budgets": {"batch": 0.10}})   # batch: ~6 of 64 blocks
+        bat = [b.submit(np.arange(60) % 250, tier="batch")
+               for _ in range(2)]               # 4 blocks each, 2nd > 6
+        lat = b.submit(np.arange(60) % 250, tier="latency")
+        b.step()
+        assert b.manager.resolve(bat[0]) in ("prefilling", "decoding")
+        assert b.manager.resolve(bat[1]) == QUEUED  # over tier budget
+        assert b.manager.resolve(lat) in ("prefilling", "decoding",
+                                          COMPLETED)  # admitted PAST it
+        b.pump(max_steps=80)
+        for uid in bat + [lat]:
+            assert b.manager.resolve(uid) == COMPLETED
+        assert b.manager.counters["shed"] == 0
+        b.engine.close()
+
+    def test_preempt_victim_order_prefers_batch_most_remaining_no_deadline(
+            self):
+        """Victim selection is deadline- and progress-aware: batch tier
+        before latency, no-deadline before deadlined, most remaining work
+        first."""
+        from deepspeed_tpu.serving.request import ServeRequest
+
+        def req(tier, deadline, remaining, uid):
+            r = ServeRequest(uid=uid, prompt=[1], submitted_at=0.0,
+                             max_new_tokens=remaining, tier=tier,
+                             deadline=deadline)
+            return r
+
+        lat = req("latency", None, 8, 1)
+        bat_big = req("batch", None, 64, 2)
+        bat_small = req("batch", None, 4, 3)
+        bat_deadline = req("batch", 99.0, 64, 4)
+        order = sorted([lat, bat_big, bat_small, bat_deadline],
+                       key=ServeRequest.preempt_key)
+        # batch before latency; within batch, no-deadline before deadlined,
+        # and more remaining work first
+        assert [r.uid for r in order] == [2, 3, 4, 1]
+
+
+# ---------------------------------------------------------------------------
 # drill wrappers (slow; the CLI is the invariant authority)
 # ---------------------------------------------------------------------------
 
@@ -331,4 +548,20 @@ def test_serve_drill_scenario(scenario, tmp_path):
     from serve_drill import run_scenario
 
     verdict = run_scenario(scenario, workdir=str(tmp_path))
+    assert verdict["ok"], verdict
+
+
+@pytest.mark.slo
+@pytest.mark.slow
+def test_serve_drill_slo_storm(tmp_path, monkeypatch):
+    """Tier-1 authority for the preemption subsystem: zero latency-tier
+    sheds under a preempt storm, >= 1 pause -> resume round-trip, streams
+    bit-identical to an injection-free replay, pools/store restored."""
+    import sys
+
+    sys.path.insert(0, _TOOLS)
+    from serve_drill import run_scenario
+
+    monkeypatch.setenv("DSTPU_BENCH_LEDGER", "0")
+    verdict = run_scenario("slo-storm", workdir=str(tmp_path))
     assert verdict["ok"], verdict

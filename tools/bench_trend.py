@@ -65,6 +65,12 @@ TRACKED = {
     # restatements — warm-over-cold start speedup and rejoins per second
     "bench_elastic": [("warm_speedup", "higher"),
                       ("rejoin_per_sec", "higher")],
+    # SLO preemption (tools/serve_drill.py --scenario slo-storm): every
+    # pause must come back (a resume failure sheds work the pause
+    # promised to preserve) and preemption churn must not crater the
+    # storm's aggregate decode throughput
+    "bench_slo": [("resume_success_rate", "higher"),
+                  ("storm_tokens_per_sec", "higher")],
 }
 
 

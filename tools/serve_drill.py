@@ -19,10 +19,14 @@ Invariants asserted after EVERY drill:
     python tools/serve_drill.py --scenario sigterm-drain
     python tools/serve_drill.py --scenario frontend-storm
     python tools/serve_drill.py --scenario prefix-storm
+    python tools/serve_drill.py --scenario slo-storm
 
 Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
-Slow pytest wrappers live in ``tests/unit/test_serving.py`` under the
-``serving`` + ``slow`` markers.
+A passing ``slo-storm`` run appends a ``bench_slo`` entry (preemption
+counters, resume success rate) to the perf ledger (``tools/
+bench_ledger.py``) unless ``--no-ledger``; ``tools/bench_trend.py``
+gates on it. Slow pytest wrappers live in ``tests/unit/test_serving.py``
+under the ``serving`` + ``slow`` markers (``slo`` for the SLO drill).
 """
 
 from __future__ import annotations
@@ -559,6 +563,87 @@ def _kv_tier_body(nvme_dir):
     return ok, details
 
 
+def scenario_slo_storm(workdir):
+    """A latency-tier burst lands on a pool already decoding batch-tier
+    work while a preempt_storm fault forces the preemption path (fp32 so
+    exactness is argmax-stable). Invariants: ZERO latency-tier sheds —
+    the storm pauses batch victims through the KV tier store instead of
+    dropping anyone; >= 1 pause→resume round-trip actually happens (by
+    counters); every request of every tier still completes and the
+    preempted streams are BIT-IDENTICAL to an injection-free replay of
+    the same workload; pool, pause store and loans fully restored."""
+    import numpy as np
+
+    from deepspeed_tpu.resilience import FaultInjector, set_injector
+
+    pkw = {"preset_kw": {"dtype": "float32"}}
+    rng = np.random.default_rng(11)
+    batch_prompts = [rng.integers(0, 250, 48) for _ in range(4)]
+    lat_prompts = [rng.integers(0, 250, 24) for _ in range(3)]
+
+    def run(inject):
+        b = _make_batcher(engine_kw=pkw, default_max_new_tokens=8,
+                          max_queue_depth=32,
+                          slo={"enabled": True, "preempt": True})
+        uids_b = [b.submit(p, tier="batch") for p in batch_prompts]
+        b.pump(max_steps=4)            # batch work prefills / starts decode
+        if inject:
+            set_injector(FaultInjector(
+                [{"kind": "preempt_storm", "times": 2}]))
+        uids_l = [b.submit(p, tier="latency", deadline_s=120.0)
+                  for p in lat_prompts]
+        b.pump(max_steps=400)
+        _fresh_injector()
+        b.pump(max_steps=400)
+        toks = {u: [int(t) for t in b.manager.done[u].generated]
+                for u in uids_b + uids_l if u in b.manager.done}
+        return b, uids_b, uids_l, toks
+
+    t0 = time.time()
+    b, uids_b, uids_l, toks = run(inject=True)
+    storm_s = time.time() - t0
+    _, base_b, base_l, base_toks = run(inject=False)
+
+    rep = b.serving_report()
+    inv = _invariants(b, uids_b + uids_l)
+    mc = b.manager.counters
+    shed_tiers = [r.tier for r in b.manager.done.values()
+                  if r.finish_reason == "shed"]
+    store = b.engine._tier_store
+    tier_rep = b.engine.tier_report() or {}
+    gen_tokens = sum(len(v) for v in toks.values())
+    bench = {
+        "metric": "resume_success_rate", "unit": "ratio",
+        "value": (mc["resumed"] / mc["paused"] if mc["paused"] else 0.0),
+        "paused": mc["paused"], "resumed": mc["resumed"],
+        "resume_success_rate": (mc["resumed"] / mc["paused"]
+                                if mc["paused"] else 0.0),
+        "storm_tokens_per_sec": round(gen_tokens / max(storm_s, 1e-9), 2),
+        "latency_sheds": sum(1 for t in shed_tiers if t == "latency"),
+    }
+    # identical uid sequence across the two runs → positional comparison
+    identical = (len(uids_b + uids_l) == len(base_b + base_l)
+                 and all(toks.get(u) == base_toks.get(v)
+                         for u, v in zip(uids_b + uids_l, base_b + base_l)))
+    details = {"report": rep, "invariants": inv, "bench": bench,
+               "states": {u: b.manager.resolve(u) for u in uids_b + uids_l},
+               "shed_tiers": shed_tiers,
+               "bit_identical_vs_unpreempted": identical,
+               "paused_requests_after": tier_rep.get("paused_requests"),
+               "store_entries_after": store.entries() if store else 0}
+    ok = (inv["ok"]
+          and mc["paused"] >= 1 and mc["resumed"] >= 1
+          and mc["resumed"] == mc["paused"]
+          and rep["counters"]["resume_failures"] == 0
+          and not any(t == "latency" for t in shed_tiers)
+          and all(b.manager.resolve(u) == "completed"
+                  for u in uids_b + uids_l)
+          and identical
+          and tier_rep.get("paused_requests", 0) == 0
+          and (store.entries() if store else 0) == 0)
+    return ok, details
+
+
 SCENARIOS = {
     "deadline-storm": scenario_deadline_storm,
     "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
@@ -566,6 +651,7 @@ SCENARIOS = {
     "frontend-storm": scenario_frontend_storm,
     "prefix-storm": scenario_prefix_storm,
     "kv-tier": scenario_kv_tier,
+    "slo-storm": scenario_slo_storm,
 }
 
 
@@ -589,6 +675,8 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", help="which drill to run")
     ap.add_argument("--all", action="store_true", help="run every scenario")
     ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="skip the bench_slo perf-ledger append")
     args = ap.parse_args(argv)
     if args.list:
         for name, fn in SCENARIOS.items():
@@ -604,6 +692,12 @@ def main(argv=None) -> int:
         print(json.dumps(verdict, indent=2, default=str))
         if not verdict["ok"]:
             rc = 1
+        elif name == "slo-storm" and not args.no_ledger:
+            from bench_ledger import append_ledger
+
+            path = append_ledger(verdict["details"]["bench"], "bench_slo")
+            print(json.dumps({"ledger": path,
+                              "bench_slo": verdict["details"]["bench"]}))
     return rc
 
 
