@@ -234,7 +234,11 @@ def run_inference_bench(cfg=None,
     # every live sequence (avg past ~ prompt + 1.5*steps midway through the
     # timed loop, block-granular reads) + the per-token scale rows of a
     # quantized pool. eff GB/s = bytes/step_time — the self-auditing
-    # roofline figure the r4 verdict asked for.
+    # roofline figure the r4 verdict asked for. Measured BEFORE the decode
+    # loops so every config row can be stated against the chip's stream
+    # roofline (achieved_gbps / stream_read_gbps), not just in isolation.
+    hbm_rates = measure_hbm_bandwidth()
+    stream_gbps = max(hbm_rates["stream_read_gbps"], 1e-9)
     Kd = cfg.num_kv_heads * cfg.head_dim
 
     def eff_gbps(occ: int, dt_step: float, wbytes: int,
@@ -244,6 +248,15 @@ def run_inference_bench(cfg=None,
         scb = (occ * blocks * 2 * eng.block_size * 4 * cfg.num_layers
                if kv_elt < 2 else 0)
         return round((wbytes - embed_bytes + kvb + scb) / dt_step / 1e9, 1)
+
+    def bw_row(occ: int, dt_step: float, wbytes: int,
+               kv_elt: float) -> Dict[str, float]:
+        g = eff_gbps(occ, dt_step, wbytes, kv_elt)
+        # eff_gbps is kept as the ledger's historical series name;
+        # achieved_gbps is the same figure under the roofline-facing name
+        # bench_trend gates, with its fraction of the measured stream rate
+        return {"eff_gbps": g, "achieved_gbps": g,
+                "roofline_frac": round(g / stream_gbps, 3)}
 
     decode = {}
     for occ in occupancies:
@@ -268,7 +281,7 @@ def run_inference_bench(cfg=None,
         decode[str(occ)] = {
             "tokens_per_sec": round(occ * decode_steps / dt, 1),
             "ms_per_token": round(dt / decode_steps * 1e3, 3),
-            "eff_gbps": eff_gbps(occ, dt / decode_steps, param_bytes, 2),
+            **bw_row(occ, dt / decode_steps, param_bytes, 2),
             "e2e_put_ms_per_step": round(e2e_ms, 2),
             # host scheduling vs dispatch vs device+transport of the last
             # e2e put (VERDICT r4 weak #4: on a tunneled runtime fetch_ms
@@ -321,7 +334,7 @@ def run_inference_bench(cfg=None,
         decode[f"{occ}_int8kv"] = {
             "tokens_per_sec": round(occ * decode_steps / dt, 1),
             "ms_per_token": round(dt / decode_steps * 1e3, 3),
-            "eff_gbps": eff_gbps(occ, dt / decode_steps, param_bytes, 1),
+            **bw_row(occ, dt / decode_steps, param_bytes, 1),
         }
         eng.flush(uids)
 
@@ -349,8 +362,7 @@ def run_inference_bench(cfg=None,
             decode[f"{occ}_w{wd}_int8kv"] = {
                 "tokens_per_sec": round(occ * decode_steps / dt, 1),
                 "ms_per_token": round(dt / decode_steps * 1e3, 3),
-                "eff_gbps": eff_gbps(occ, dt / decode_steps, wq_bytes[wd],
-                                     1),
+                **bw_row(occ, dt / decode_steps, wq_bytes[wd], 1),
             }
             eng.flush(uids)
 
@@ -614,18 +626,108 @@ def run_inference_bench(cfg=None,
         "device": getattr(dev, "device_kind", str(dev)),
         # measured in-bench (r4 verdict weak #1: the old hardcoded 150 GB/s
         # figure was presented as a measurement); decode rooflines above
-        # (eff_gbps) are judged against stream_read_gbps
-        "measured_hbm_gbps": measure_hbm_bandwidth(),
+        # (achieved_gbps / roofline_frac) are judged against
+        # stream_read_gbps
+        "measured_hbm_gbps": hbm_rates,
+    }
+
+
+def run_decode_kernel_bench(cfg=None,
+                            occupancies: Sequence[int] = (128, 256),
+                            prompt: int = 512, decode_steps: int = 64,
+                            params=None) -> Dict[str, object]:
+    """A/B the fused Pallas work-list decode kernel against its XLA
+    dense-gather twin through the public engine surface: same model, same
+    prompts, ``decode_kernel='pallas'`` vs ``'xla'``. Per occupancy the
+    result carries both paths' tokens/s, the speedup, and whether the
+    greedy token streams matched — the ledger series ``bench_trend.py``
+    gates (``configs.*.pallas_tokens_per_sec`` / ``configs.*.speedup``).
+    On the CPU dev harness the Pallas kernel runs in interpret mode, so
+    the speedup there is NOT the hardware figure — the >2x occ-128/256
+    target is asserted by ``tools/decode_kernel_drill.py`` on real TPU."""
+    import jax
+
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.ops.paged_attention import decode_kernel_support
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if cfg is None:
+        if on_tpu:
+            cfg = TransformerConfig(
+                vocab_size=32000, hidden_size=1536, num_layers=16,
+                num_heads=12, num_kv_heads=6, max_seq_len=4096, arch="llama")
+        else:  # dev fallback so the harness runs anywhere; fp32 because
+            # bit-identical greedy tokens are part of the dev contract
+            # (bf16's coarse mantissa lets the two paths' reduction orders
+            # pick different argmax winners — a precision artifact, not a
+            # kernel bug, so identity is only asserted in fp32)
+            cfg = TransformerConfig(vocab_size=512, hidden_size=128,
+                                    num_layers=2, num_heads=4,
+                                    max_seq_len=512, arch="llama",
+                                    dtype="float32")
+            occupancies = tuple(o for o in occupancies if o <= 4) or (2,)
+            prompt, decode_steps = 64, 8
+    model = TransformerLM(cfg)
+    if params is None:
+        params = jax.jit(model.init)(jax.random.key(0))
+    mode, reason = decode_kernel_support()
+    ctx = prompt + 2 * decode_steps + 8
+    configs: Dict[str, Dict[str, object]] = {}
+    for occ in occupancies:
+        row: Dict[str, object] = {}
+        toks_by = {}
+        for kern in ("pallas", "xla"):
+            rng = np.random.default_rng(7)      # same prompts per kernel
+            eng = InferenceEngineV2(model, params=params, max_sequences=occ,
+                                    max_seq_len=ctx, block_size=128,
+                                    decode_kernel=kern)
+            uids = list(range(occ))
+            first = {}
+            for i in range(0, occ, 32):
+                grp = uids[i:i + 32]
+                r = eng.put(grp, [rng.integers(0, cfg.vocab_size, prompt)
+                                  for _ in grp])
+                first.update({u: int(np.argmax(r[u])) for u in grp})
+            t0s = [first[u] for u in uids]
+            eng.decode_batch(uids, t0s, steps=decode_steps)  # warmup/compile
+            t0 = time.perf_counter()
+            out = eng.decode_batch(uids, t0s, steps=decode_steps)
+            dt = time.perf_counter() - t0
+            row[f"{kern}_tokens_per_sec"] = round(occ * decode_steps / dt, 1)
+            row[f"{kern}_ms_per_token"] = round(dt / decode_steps * 1e3, 3)
+            toks_by[kern] = np.stack([out[u] for u in uids])
+            eng.flush(uids)
+            del eng
+        row["speedup"] = round(
+            float(row["pallas_tokens_per_sec"])
+            / max(float(row["xla_tokens_per_sec"]), 1e-9), 3)
+        row["greedy_identical"] = bool(
+            np.array_equal(toks_by["pallas"], toks_by["xla"]))
+        configs[str(occ)] = row
+    return {
+        "metric": "decode_kernel_bench",
+        "kernel_mode": mode or "xla",     # native | interpret | xla
+        "kernel_reason": reason,
+        "configs": configs,
+        "dtype": cfg.dtype,
+        "prompt_len": prompt,
+        "decode_steps": decode_steps,
+        "device": getattr(dev, "device_kind", str(dev)),
     }
 
 
 def main() -> None:
     result = {"metric": "serving_bench", **run_inference_bench()}
     print(json.dumps(result))
+    kernel = run_decode_kernel_bench()
+    print(json.dumps(kernel))
     try:  # perf-trend ledger (best-effort; never sinks the bench)
         from bench import _ledger
 
         _ledger(result, "bench_infer")
+        _ledger(kernel, "bench_decode_kernel")
     except Exception:
         pass
 

@@ -971,13 +971,26 @@ class SpeculativeConfig(DSTpuConfigModel):
 class InferenceConfig(DSTpuConfigModel):
     """``inference`` section: engine-level serving performance features
     (consumed by :class:`~deepspeed_tpu.inference.engine_v2.
-    InferenceEngineV2` via its ``prefix_cache=`` / ``speculative=``
-    kwargs)."""
+    InferenceEngineV2` via its ``prefix_cache=`` / ``speculative=`` /
+    ``decode_kernel=`` kwargs)."""
 
     prefix_cache: PrefixCacheConfig = Field(
         default_factory=PrefixCacheConfig)
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
+    # packed-paged decode attention kernel: "pallas" = the fused work-list
+    # flash-decode kernel (native on TPU, interpret mode on CPU; falls back
+    # to the XLA twin with one logged warning when neither is available),
+    # "xla" = force the dense-gather XLA reference path
+    decode_kernel: str = "pallas"
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.decode_kernel not in ("pallas", "xla"):
+            raise ValueError(
+                "inference.decode_kernel must be 'pallas' or 'xla', got "
+                f"{self.decode_kernel!r}")
+        return self
 
 
 class ProfileTriggerConfig(DSTpuConfigModel):

@@ -61,7 +61,9 @@ class InferenceEngineV2:
                  packed: bool = True, topology=None,
                  mesh: Optional[dict] = None, kv_dtype: str = "bf16",
                  weight_dtype: str = "bf16", prefix_cache=None,
-                 speculative=None):
+                 speculative=None, decode_kernel: str = "pallas"):
+        import functools
+
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from deepspeed_tpu.parallel import build_mesh
@@ -143,6 +145,32 @@ class InferenceEngineV2:
             # tp would split pairs across shards
             raise ValueError("kv_dtype='int4' does not compose with tp>1 "
                              "(use int8 KV under tensor parallelism)")
+        # ---- decode attention kernel selection (inference.decode_kernel):
+        # "pallas" = the fused work-list flash-decode kernel (native on TPU,
+        # interpret mode on CPU CI), "xla" = the dense-gather reference twin.
+        # Resolved ONCE here — the choice is baked into the step jits below,
+        # so a backend with no Pallas lowering falls back to xla with one
+        # logged warning instead of failing at trace time.
+        if decode_kernel not in ("pallas", "xla"):
+            raise ValueError(f"decode_kernel must be 'pallas' or 'xla', got "
+                             f"{decode_kernel!r}")
+        self.decode_kernel_reason = ""
+        if decode_kernel == "pallas":
+            from deepspeed_tpu.ops import paged_attention as _pa
+
+            mode, reason = _pa.decode_kernel_support()
+            if mode is None:
+                import logging
+
+                log_dist(f"decode_kernel: Pallas unavailable ({reason}); "
+                         f"falling back to the XLA reference path",
+                         level=logging.WARNING)
+                decode_kernel, mode = "xla", "xla"
+                self.decode_kernel_reason = reason
+            self.decode_kernel_mode = mode   # native | interpret | xla
+        else:
+            self.decode_kernel_mode = "xla"
+        self.decode_kernel = decode_kernel
         if paged:
             self.num_blocks = self.state.allocator.num_blocks
             cache = model.init_paged_kv_cache(
@@ -172,7 +200,13 @@ class InferenceEngineV2:
             self._step = jax.jit(model.forward_with_paged_cache,
                                  donate_argnums=(2,),
                                  out_shardings=(None, kv_out))
-            self._step_packed = jax.jit(model.forward_with_packed_cache,
+            # the kernel choice rides a keyword-bound partial so the
+            # positional donate/static indices stay valid (a traced string
+            # argument would not jit)
+            self._fwd_packed = functools.partial(
+                model.forward_with_packed_cache,
+                decode_kernel=self.decode_kernel)
+            self._step_packed = jax.jit(self._fwd_packed,
                                         donate_argnums=(2,),
                                         static_argnums=(8, 9, 10),
                                         out_shardings=(None, kv_out))
@@ -180,6 +214,11 @@ class InferenceEngineV2:
                                         donate_argnums=(1,),
                                         static_argnums=(6, 9, 10, 11),
                                         out_shardings=(None, kv_out))
+            # fused promote-prologue twins of the two decode dispatches,
+            # built lazily on the first fenced step (they close over
+            # whether the pool carries int8 scales)
+            self._decode_loop_fused = None
+            self._step_packed_fused = None
             self._prefill_step = jax.jit(self._prefill_impl,
                                          donate_argnums=(3,),
                                          out_shardings=(None, kv_out))
@@ -303,7 +342,14 @@ class InferenceEngineV2:
         self.spec_stats: Dict[str, int] = {
             "rounds": 0, "drafted": 0, "accepted": 0, "emitted": 0,
             "fallback_steps": 0,
+            # verify rounds run through the fused Pallas kernel (the same
+            # _step_packed jit as put) — lets benches attribute spec wins
+            # to the kernel vs the scheduling
+            "fused": 1 if self.decode_kernel == "pallas" else 0,
         }
+        # standalone promote-scatter dispatches absorbed into a fused
+        # decode/step prologue (surfacing in tier_report)
+        self._fused_saved_dispatches = 0
 
     _QUANT_LEAVES = QUANT_LEAVES
 
@@ -329,6 +375,18 @@ class InferenceEngineV2:
                 "put(): device step + logits D2H (ms)"),
             "tokens": r.counter("inference/tokens",
                                 "tokens pushed through put()"),
+            "decode_dispatches": r.counter(
+                "inference/decode_dispatches",
+                "fused decode-scan device dispatches (decode_batch)"),
+            "decode_tokens": r.counter(
+                "inference/decode_tokens",
+                "tokens generated by decode_batch scans"),
+            "decode_fetch_ms": r.histogram(
+                "inference/decode_fetch_ms",
+                "decode_batch: device scan + token D2H (ms)"),
+            "decode_prologue_promotes": r.counter(
+                "inference/decode_prologue_promotes",
+                "tier promotions folded into a fused step prologue"),
         }
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
@@ -475,7 +533,12 @@ class InferenceEngineV2:
                       args={"pending": len(recs)}):
             return self._flush_promotes_impl(recs)
 
-    def _flush_promotes_impl(self, recs) -> None:
+    def _build_promote_payloads(self, recs):
+        """Stale-filter the promote records and materialise their scatter
+        payloads (fetch waits happen here). Returns ``(recs, failed, idx,
+        kp, vp, sp)`` ready for :meth:`_promote_impl` — whether that runs
+        standalone or as a fused step prologue — or ``None`` when every
+        record was stale."""
         stale = [r for r in recs if r.epoch != self.prefix_cache.epoch]
         if stale:
             # a clear() between attach and this fence released these
@@ -489,7 +552,7 @@ class InferenceEngineV2:
                 self._tier_store.discard(rec.key)
             recs = [r for r in recs if r.epoch == self.prefix_cache.epoch]
             if not recs:
-                return
+                return None
         n = len(recs)
         npad = max(4, 1 << (n - 1).bit_length())
         kt = self.cache["k"]
@@ -523,22 +586,12 @@ class InferenceEngineV2:
             vp[:, i] = parts["v"]
             if sp is not None:
                 sp[:, i] = parts["kv_scale"]
-        try:
-            with jax.sharding.set_mesh(self.mesh):
-                if sp is None:
-                    self.cache = self._promote_step(
-                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
-                        jnp.asarray(vp))
-                else:
-                    self.cache = self._promote_step(
-                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
-                        jnp.asarray(vp), jnp.asarray(sp))
-        except BaseException:
-            # upload never happened: re-demote onto the still-intact tier
-            # entries so the blocks (garbage) leave the tree and the
-            # fetch loans return to the pool, then surface the failure
-            self.prefix_cache.cancel_promotes(recs)
-            raise
+        return recs, failed, idx, kp, vp, sp
+
+    def _finish_promotes(self, recs, failed) -> None:
+        """Post-upload bookkeeping shared by the standalone scatter and the
+        fused prologue: return the fetch loans, drop the store entries,
+        observe promote latency, publish the uploaded nodes."""
         now = time.perf_counter()
         for rec in recs:
             rec.fetch.release()
@@ -556,6 +609,101 @@ class InferenceEngineV2:
             # the next demotion would persist them into the tier
             self.prefix_cache.drop_failed_promote(rec.node)
 
+    def _flush_promotes_impl(self, recs) -> None:
+        built = self._build_promote_payloads(recs)
+        if built is None:
+            return
+        recs, failed, idx, kp, vp, sp = built
+        try:
+            with jax.sharding.set_mesh(self.mesh):
+                if sp is None:
+                    self.cache = self._promote_step(
+                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                        jnp.asarray(vp))
+                else:
+                    self.cache = self._promote_step(
+                        self.cache, jnp.asarray(idx), jnp.asarray(kp),
+                        jnp.asarray(vp), jnp.asarray(sp))
+        except BaseException:
+            # upload never happened: re-demote onto the still-intact tier
+            # entries so the blocks (garbage) leave the tree and the
+            # fetch loans return to the pool, then surface the failure
+            self.prefix_cache.cancel_promotes(recs)
+            raise
+        self._finish_promotes(recs, failed)
+
+    # ---- fused promote prologue (decode_kernel='pallas') -----------------
+    def _fence_promotes(self):
+        """The dispatch-site promote fence. With the fused kernel active the
+        pending prefix promotions do NOT get their own donated scatter —
+        their payloads are returned here and the caller threads them into
+        the upcoming step's fused prologue (one dispatch instead of two).
+        Pending RESUME uploads always flush standalone first: a failed
+        resume read unwinds the whole resume rather than zero-filling, a
+        policy the prologue (which must always dispatch) cannot express.
+        Returns ``(recs, failed, idx, kp, vp, sp)`` or ``None`` (nothing to
+        fuse — already flushed, stale, or the xla path is active)."""
+        if self._pause_q:
+            self._flush_pause_promotes()
+        if self.decode_kernel != "pallas":
+            self._flush_promotes()
+            return None
+        recs, self._promote_q = self._promote_q, []
+        if not recs:
+            return None
+        return self._build_promote_payloads(recs)
+
+    def _psp(self, sp):
+        """The fused jits take the scale payload positionally; a scale-less
+        pool passes this zero-size sentinel (dead-code under jit)."""
+        return (jnp.asarray(sp) if sp is not None
+                else jnp.zeros((0,), jnp.float32))
+
+    def _finish_fused_promotes(self, recs, failed) -> None:
+        self._finish_promotes(recs, failed)
+        self._fused_saved_dispatches += 1
+        if self._obs is not None:
+            self._obs["decode_prologue_promotes"].inc(float(len(recs)))
+        bus = self._ebus
+        if bus.enabled:
+            bus.instant("engine", "promote_fence_fused",
+                        args={"promotes": len(recs),
+                              "failed": len(failed)})
+
+    def _get_decode_loop_fused(self):
+        if self._decode_loop_fused is None:
+            has_sc = "kv_scale" in self.cache
+
+            def fused(params, cache, pidx, pkp, pvp, psp, bt, slots, pos0,
+                      tok0, steps, valid, rng, temperature, top_k, top_p):
+                cache = self._promote_impl(cache, pidx, pkp, pvp,
+                                           psp if has_sc else None)
+                return self._multi_decode(params, cache, bt, slots, pos0,
+                                          tok0, steps, valid, rng,
+                                          temperature, top_k, top_p)
+
+            self._decode_loop_fused = jax.jit(
+                fused, donate_argnums=(1,), static_argnums=(10, 13, 14, 15),
+                out_shardings=(None, self._kv_out))
+        return self._decode_loop_fused
+
+    def _get_step_packed_fused(self):
+        if self._step_packed_fused is None:
+            has_sc = "kv_scale" in self.cache
+
+            def fused(params, tok_ids, cache, pidx, pkp, pvp, psp, bt,
+                      tok_slot, tok_pos, valid, gidx, dr, tile, no_past):
+                cache = self._promote_impl(cache, pidx, pkp, pvp,
+                                           psp if has_sc else None)
+                return self._fwd_packed(params, tok_ids, cache, bt,
+                                        tok_slot, tok_pos, valid, gidx,
+                                        dr, tile, no_past)
+
+            self._step_packed_fused = jax.jit(
+                fused, donate_argnums=(2,), static_argnums=(12, 13, 14),
+                out_shardings=(None, self._kv_out))
+        return self._step_packed_fused
+
     def tier_report(self) -> Optional[Dict]:
         """Tier-store snapshot + pending promote depth (None = tiers off)."""
         if self._tier_store is None:
@@ -563,7 +711,9 @@ class InferenceEngineV2:
         return {**self._tier_store.report(),
                 "pending_promotes": len(self._promote_q),
                 "paused_requests": len(self._paused),
-                "pending_resumes": len(self._pause_q)}
+                "pending_resumes": len(self._pause_q),
+                "fused_prologue_dispatches_saved":
+                    self._fused_saved_dispatches}
 
     # ---- serving preemption: pause / resume through the tier store -------
     def _ensure_pause_store(self):
@@ -907,7 +1057,7 @@ class InferenceEngineV2:
             tk, tv, toks = carry
             logits, tail = self.module.forward_decode_tail(
                 params, toks, cache, {"k": tk, "v": tv}, t, bt, slots, pos0,
-                valid)
+                valid, decode_kernel=self.decode_kernel)
             if temperature > 0.0:
                 from deepspeed_tpu.inference.engine import sample_token
 
@@ -1002,15 +1152,42 @@ class InferenceEngineV2:
         tok0 = np.zeros((bpad,), np.int32)
         tok0[:B] = np.asarray(batch_tokens, np.int32).reshape(B)
         valid = np.arange(bpad) < B
+        fused = None
         if self._promote_q or self._pause_q:
-            self._flush_promotes()      # fence: no read of a promoted
-        with jax.sharding.set_mesh(self.mesh):  # block before its upload
-            out, self.cache = self._decode_loop(
-                self.params, self.cache, jnp.asarray(self._block_tables()),
-                jnp.asarray(slots), jnp.asarray(pos0), jnp.asarray(tok0),
-                steps, jnp.asarray(valid), jax.random.key(seed),
-                float(temperature), int(top_k), float(top_p))
+            fused = self._fence_promotes()  # fence: no read of a promoted
+        t_disp = time.perf_counter()        # block before its upload
+        with jax.sharding.set_mesh(self.mesh):
+            if fused is None:
+                out, self.cache = self._decode_loop(
+                    self.params, self.cache,
+                    jnp.asarray(self._block_tables()),
+                    jnp.asarray(slots), jnp.asarray(pos0),
+                    jnp.asarray(tok0), steps, jnp.asarray(valid),
+                    jax.random.key(seed), float(temperature), int(top_k),
+                    float(top_p))
+            else:
+                # promotions ride the scan's prologue: one donated
+                # dispatch scatters the payloads AND runs the decode loop
+                recs, failed, idx, kp, vp, sp = fused
+                try:
+                    out, self.cache = self._get_decode_loop_fused()(
+                        self.params, self.cache, jnp.asarray(idx),
+                        jnp.asarray(kp), jnp.asarray(vp), self._psp(sp),
+                        jnp.asarray(self._block_tables()),
+                        jnp.asarray(slots), jnp.asarray(pos0),
+                        jnp.asarray(tok0), steps, jnp.asarray(valid),
+                        jax.random.key(seed), float(temperature),
+                        int(top_k), float(top_p))
+                except BaseException:
+                    self.prefix_cache.cancel_promotes(recs)
+                    raise
+                self._finish_fused_promotes(recs, failed)
             toks = np.asarray(out)            # [steps, bpad]
+        if self._obs is not None:
+            self._obs["decode_dispatches"].inc(1.0)
+            self._obs["decode_tokens"].inc(float(steps * B))
+            self._obs["decode_fetch_ms"].observe(
+                (time.perf_counter() - t_disp) * 1e3)
         for i, d in enumerate(descs):
             self._pos[d.slot] = d.seen_tokens + steps
             # fed tokens = the start token + all but the last output (the
@@ -1153,14 +1330,31 @@ class InferenceEngineV2:
             goff[i] = g
             gidx[g:g + len(c)] = starts[i] + np.arange(len(c))
             g += len(c)
+        fused = None
         if self._promote_q or self._pause_q:
-            self._flush_promotes()      # promote-completion fence
+            fused = self._fence_promotes()  # promote-completion fence
         with jax.sharding.set_mesh(self.mesh):
-            logits, self.cache = self._step_packed(
-                self.params, jnp.asarray(tok_ids), self.cache,
-                jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
-                jnp.asarray(tok_pos), jnp.asarray(valid),
-                jnp.asarray(gidx), dr, tile, no_past)
+            if fused is None:
+                logits, self.cache = self._step_packed(
+                    self.params, jnp.asarray(tok_ids), self.cache,
+                    jnp.asarray(self._block_tables()),
+                    jnp.asarray(tok_slot), jnp.asarray(tok_pos),
+                    jnp.asarray(valid), jnp.asarray(gidx), dr, tile,
+                    no_past)
+            else:
+                recs, failed, idx, kp, vp, sp = fused
+                try:
+                    logits, self.cache = self._get_step_packed_fused()(
+                        self.params, jnp.asarray(tok_ids), self.cache,
+                        jnp.asarray(idx), jnp.asarray(kp), jnp.asarray(vp),
+                        self._psp(sp), jnp.asarray(self._block_tables()),
+                        jnp.asarray(tok_slot), jnp.asarray(tok_pos),
+                        jnp.asarray(valid), jnp.asarray(gidx), dr, tile,
+                        no_past)
+                except BaseException:
+                    self.prefix_cache.cancel_promotes(recs)
+                    raise
+                self._finish_fused_promotes(recs, failed)
             out = np.asarray(logits)                       # [gpad, V]
         results: Dict[int, np.ndarray] = {}
         info = {"drafted": int(G - len(descs)), "accepted": 0, "emitted": 0,
@@ -1430,15 +1624,33 @@ class InferenceEngineV2:
             gather_idx = np.zeros((Bs,), np.int32)
             for i, c in enumerate(chunks):       # chunk end → next-token
                 gather_idx[i] = starts[i] + len(c) - 1
+            fused = None
             if self._promote_q or self._pause_q:
-                self._flush_promotes()  # promote-completion fence
+                fused = self._fence_promotes()  # promote-completion fence
             t_host = time.perf_counter()
             with jax.sharding.set_mesh(self.mesh):
-                logits, self.cache = self._step_packed(
-                    self.params, jnp.asarray(tok_ids), self.cache,
-                    jnp.asarray(self._block_tables()), jnp.asarray(tok_slot),
-                    jnp.asarray(tok_pos), jnp.asarray(valid),
-                    jnp.asarray(gather_idx), dr, tile, no_past)
+                if fused is None:
+                    logits, self.cache = self._step_packed(
+                        self.params, jnp.asarray(tok_ids), self.cache,
+                        jnp.asarray(self._block_tables()),
+                        jnp.asarray(tok_slot), jnp.asarray(tok_pos),
+                        jnp.asarray(valid), jnp.asarray(gather_idx), dr,
+                        tile, no_past)
+                else:
+                    recs, failed, idx, kp, vp, sp = fused
+                    try:
+                        logits, self.cache = self._get_step_packed_fused()(
+                            self.params, jnp.asarray(tok_ids), self.cache,
+                            jnp.asarray(idx), jnp.asarray(kp),
+                            jnp.asarray(vp), self._psp(sp),
+                            jnp.asarray(self._block_tables()),
+                            jnp.asarray(tok_slot), jnp.asarray(tok_pos),
+                            jnp.asarray(valid), jnp.asarray(gather_idx),
+                            dr, tile, no_past)
+                    except BaseException:
+                        self.prefix_cache.cancel_promotes(recs)
+                        raise
+                    self._finish_fused_promotes(recs, failed)
                 t_disp = time.perf_counter()
                 out = np.asarray(logits)
             t_fetch = time.perf_counter()

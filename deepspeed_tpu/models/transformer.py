@@ -1122,7 +1122,8 @@ class TransformerLM:
                                   gather_idx: jax.Array,
                                   decode_rows: Optional[int] = None,
                                   tile_tq: int = 128,
-                                  tiles_no_past: bool = False) -> Any:
+                                  tiles_no_past: bool = False,
+                                  decode_kernel: str = "pallas") -> Any:
         """Token-packed continuous-batching step (ragged_wrapper.py parity).
 
         Unlike :meth:`forward_with_paged_cache`'s dense ``[max_sequences,
@@ -1201,14 +1202,16 @@ class TransformerLM:
                             q2[:dr], k2[:dr], v2[:dr], cache["k"], cache["v"],
                             block_tables, a_slot_d, a_pos_d, a_len_d, tq=1,
                             window=cseg.sliding_window, layer=li,
-                            kv_scale=kv_scale, kv_bits=self._kv_bits(cache)))
+                            kv_scale=kv_scale, kv_bits=self._kv_bits(cache),
+                            kernel=decode_kernel))
                     if n_tiles:
                         parts.append(ragged_paged_attention_tp(
                             q2[dr:], k2[dr:], v2[dr:], cache["k"], cache["v"],
                             block_tables, a_slot_t, a_pos_t, a_len_t,
                             tq=tile_tq, window=cseg.sliding_window, layer=li,
                             no_past=tiles_no_past, kv_scale=kv_scale,
-                            kv_bits=self._kv_bits(cache)))
+                            kv_bits=self._kv_bits(cache),
+                            kernel=decode_kernel))
                     out = (parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))
                     return out[:, None]                         # [N, 1, H, d]
@@ -1338,7 +1341,8 @@ class TransformerLM:
                             tail: Dict[str, jax.Array], t: jax.Array,
                             block_tables: jax.Array, slots: jax.Array,
                             pos_base: jax.Array,
-                            valid: Optional[jax.Array] = None) -> Any:
+                            valid: Optional[jax.Array] = None,
+                            decode_kernel: str = "pallas") -> Any:
         """One fused-loop decode step with the pool READ-ONLY.
 
         The engine's multi-step decode scan cannot scatter into the paged
@@ -1396,7 +1400,8 @@ class TransformerLM:
                         q2, cache["k"], cache["v"], li, block_tables, slots,
                         pos_base, window=window, row_pos=row_pos,
                         kv_scale=cache.get("kv_scale"),
-                        kv_bits=self._kv_bits(cache))
+                        kv_bits=self._kv_bits(cache),
+                        kernel=decode_kernel)
                     # append self into the tail, then attend tail cols <= t
                     tk2 = jax.lax.dynamic_update_slice(
                         tk, k2[None, :, None].astype(tk.dtype),

@@ -44,6 +44,31 @@ def _on_tpu() -> bool:
         return False
 
 
+def decode_kernel_support() -> Tuple[Optional[str], str]:
+    """How the fused Pallas decode kernel can run on this backend:
+    ``("native", why)`` on TPU (Mosaic lowering), ``("interpret", why)`` on
+    CPU (the CI parity mode), ``(None, why)`` anywhere else — the engine
+    logs ``why`` and falls back to ``decode_kernel: xla``."""
+    try:
+        backend = jax.default_backend()
+    except Exception as e:                     # no devices / broken runtime
+        return None, f"backend probe failed: {e!r}"
+    if backend == "tpu":
+        return "native", "TPU backend: Mosaic lowering available"
+    if backend == "cpu":
+        return "interpret", "CPU backend: Pallas interpret mode"
+    return None, (f"backend {backend!r} has no Pallas TPU lowering "
+                  f"(only tpu/native and cpu/interpret are supported)")
+
+
+def _check_kernel(kernel: str) -> bool:
+    """Validate a ``kernel=`` selector; True when the XLA twin was asked
+    for explicitly (the Pallas work-list kernel is the default)."""
+    if kernel not in ("pallas", "xla"):
+        raise ValueError(f"kernel must be 'pallas' or 'xla', got {kernel!r}")
+    return kernel == "xla"
+
+
 # ---------------------------------------------------------------------------
 # block-table math (shared by kernel wrapper and scatter)
 # ---------------------------------------------------------------------------
@@ -517,7 +542,8 @@ def _decode_kernel(*refs, scale: float, bs: int, K: int, rep: int,
 
 def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
                          atom_pos0, *, window=None, row_pos=None,
-                         interpret=None, kv_scale=None, kv_bits: int = 8):
+                         interpret=None, kv_scale=None, kv_bits: int = 8,
+                         kernel: str = "pallas"):
     """(acc, m, l) flash-decode partials of each decode row's attention over
     its POOL-cached past (positions < pos0). ``row_pos`` is the query's true
     position (defaults to pos0) — it only matters for sliding windows, e.g.
@@ -525,7 +551,11 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     q [A, H, d]; pools STACKED lane-folded [L, nbp1, bs, K*d] — bf16, or
     int8/int4 (``kv_bits``; int4 packs lane j with j + K*d/2 per byte) with
     ``kv_scale`` [L, nbp1, 1, 2*bs] per-token dequant scales.
+    ``kernel='xla'`` (``inference.decode_kernel``) routes straight to the
+    dense-gather twin — same math, for A/B benching and as the logged
+    fallback when Pallas is unavailable.
     Returns fp32 acc [A, H, d] (unnormalized), m/l [A, H]."""
+    use_xla = _check_kernel(kernel)
     if interpret is None:
         interpret = not _on_tpu()
     A, H, d = q.shape
@@ -537,7 +567,7 @@ def decode_pool_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
     quantized = kv_scale is not None
     if row_pos is None:
         row_pos = atom_pos0
-    if not interpret and (d % 128 or bs % 8):
+    if use_xla or (not interpret and (d % 128 or bs % 8)):
         return xla_decode_partials(q, k_pool, v_pool, layer, block_tables,
                                    atom_slot, atom_pos0, window=window,
                                    row_pos=row_pos, kv_scale=kv_scale,
@@ -676,7 +706,7 @@ def xla_decode_partials(q, k_pool, v_pool, layer, block_tables, atom_slot,
 def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
                             atom_slot, atom_pos0, axis: str = "tp",
                             window=None, row_pos=None, kv_scale=None,
-                            kv_bits: int = 8):
+                            kv_bits: int = 8, kernel: str = "pallas"):
     """Tensor-parallel :func:`decode_pool_partials` (heads embarrassingly
     parallel: q on H, pools on K, partials out on H; per-token int8 scales
     replicated)."""
@@ -688,7 +718,7 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
         return decode_pool_partials(q, k_pool, v_pool, layer, block_tables,
                                     atom_slot, atom_pos0, window=window,
                                     row_pos=row_pos, kv_scale=kv_scale,
-                                    kv_bits=kv_bits)
+                                    kv_bits=kv_bits, kernel=kernel)
     if row_pos is None:
         row_pos = atom_pos0
 
@@ -702,7 +732,8 @@ def decode_pool_partials_tp(q, k_pool, v_pool, layer, block_tables,
     def shard_fn(q, kp, vp, lay, bt, a_s, a_p, rp, sc):
         return decode_pool_partials(
             q, kp, vp, lay, bt, a_s, a_p, window=window, row_pos=rp,
-            kv_scale=sc if sc.ndim == 4 else None, kv_bits=kv_bits)
+            kv_scale=sc if sc.ndim == 4 else None, kv_bits=kv_bits,
+            kernel=kernel)
 
     return jax.shard_map(
         shard_fn,
@@ -1056,7 +1087,8 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
                            layer: Optional[jax.Array] = None,
                            no_past: bool = False,
                            kv_scale: Optional[jax.Array] = None,
-                           kv_bits: int = 8) -> jax.Array:
+                           kv_bits: int = 8,
+                           kernel: str = "pallas") -> jax.Array:
     """Attention over atoms of the packed token row.
 
     ``q``/``k_self``/``v_self``: [N, H|K, d] with N = n_atoms*tq; atom ``a``
@@ -1074,7 +1106,10 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
     kernel when the engine knows every chunk starts at position 0.
     Dispatches to the decode work-list kernel (tq == 1) or the
     past+self-flash pair (tq > 1); see the section comment above.
-    Returns [N, H, d]."""
+    ``kernel='xla'`` forces the dense-gather reference path for every atom
+    (``inference.decode_kernel`` — A/B benching and the no-Pallas
+    fallback). Returns [N, H, d]."""
+    use_xla = _check_kernel(kernel)
     if interpret is None:
         interpret = not _on_tpu()
     N, H, d = q.shape
@@ -1092,8 +1127,10 @@ def ragged_paged_attention(q: jax.Array, k_self: jax.Array, v_self: jax.Array,
     bs = k_pool.shape[2]
     # Mosaic wants 128-lane-aligned DMA chunks and reshapes; geometries off
     # the serving sweet spot (small head_dim models, tiny test configs) take
-    # the dense-gather XLA path instead — numerically identical
-    if not interpret and (d % 128 or bs % 8 or (tq > 1 and bs % 128)):
+    # the dense-gather XLA path instead — numerically identical. An
+    # explicit kernel='xla' takes the same route unconditionally.
+    if use_xla or (not interpret
+                   and (d % 128 or bs % 8 or (tq > 1 and bs % 128))):
         kp = jax.lax.dynamic_index_in_dim(k_pool, layer, keepdims=False)
         vp = jax.lax.dynamic_index_in_dim(v_pool, layer, keepdims=False)
         if kv_scale is not None and kv_bits == 4:
@@ -1133,7 +1170,8 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                               layer: Optional[jax.Array] = None,
                               no_past: bool = False,
                               kv_scale: Optional[jax.Array] = None,
-                              kv_bits: int = 8) -> jax.Array:
+                              kv_bits: int = 8,
+                              kernel: str = "pallas") -> jax.Array:
     """Tensor-parallel :func:`ragged_paged_attention`: heads embarrassingly
     parallel, q sharded on H, the atom KV and pools on K under shard_map
     (int8 per-token scales replicated)."""
@@ -1146,7 +1184,8 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                                       block_tables, atom_slot, atom_pos0,
                                       atom_len, tq, window=window,
                                       layer=layer, no_past=no_past,
-                                      kv_scale=kv_scale, kv_bits=kv_bits)
+                                      kv_scale=kv_scale, kv_bits=kv_bits,
+                                      kernel=kernel)
     tp = mesh.shape[axis]
     H = q.shape[1]
     d = q.shape[2]
@@ -1174,7 +1213,7 @@ def ragged_paged_attention_tp(q: jax.Array, k_self: jax.Array,
                                       tq, window=window, layer=lay,
                                       no_past=no_past,
                                       kv_scale=sc if sc.ndim == 4 else None,
-                                      kv_bits=kv_bits)
+                                      kv_bits=kv_bits, kernel=kernel)
 
     return jax.shard_map(
         shard_fn,

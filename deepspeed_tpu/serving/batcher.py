@@ -944,6 +944,12 @@ class ContinuousBatcher:
                              else None)},
             "prefix_cache": pc.report() if pc is not None else None,
             "speculative": spec,
+            "decode_kernel": {
+                "kernel": getattr(self.engine, "decode_kernel", None),
+                "mode": getattr(self.engine, "decode_kernel_mode", None),
+                "fallback_reason":
+                    getattr(self.engine, "decode_kernel_reason", "") or None,
+            },
             "latency_ms": {"p50": round(self._latency_pct(50), 3),
                            "p99": round(self._latency_pct(99), 3),
                            "samples": self._step_window.count},

@@ -40,7 +40,17 @@ except ImportError:                      # invoked as tools/bench_trend.py
 TRACKED = {
     "bench": [("value", "higher")],
     "bench_infer": [("prefill_tokens_per_sec", "higher"),
-                    ("decode.*.tokens_per_sec", "higher")],
+                    ("decode.*.tokens_per_sec", "higher"),
+                    # achieved GB/s vs the measured stream roofline: a
+                    # config that keeps its tok/s by shrinking its streamed
+                    # bytes (e.g. a silently shorter context) still gates
+                    ("decode.*.achieved_gbps", "higher")],
+    # fused Pallas decode kernel vs its XLA dense-gather twin
+    # (bench_infer.run_decode_kernel_bench / the decode-kernel drill):
+    # per-occupancy series — the kernel's own throughput must not regress,
+    # and neither may its advantage over the reference path
+    "bench_decode_kernel": [("configs.*.pallas_tokens_per_sec", "higher"),
+                            ("configs.*.speedup", "higher")],
     # capacity is a PER-(DEVICE, LADDER) series: the rung set runs on the
     # dev CPU harness and on real chips with different achievable maxima,
     # and a dev restatement must neither trip a phantom regression against
