@@ -806,6 +806,42 @@ class SLOConfig(DSTpuConfigModel):
         return self
 
 
+class MigrationConfig(DSTpuConfigModel):
+    """``serving.migration``: durable cross-replica request migration.
+
+    When enabled, every pause additionally exports a DURABLE copy of the
+    victim's KV through the tier store onto a shared NVMe namespace
+    (``shared_nvme_path``, reachable by every replica) plus an atomic
+    per-request resume manifest (tier keys, seen_tokens, token history,
+    sha256). A sibling replica can then ADOPT the manifest after the donor
+    crashes — ``ReplicaRouter.capture_dead`` re-homes severed DECODING/
+    PAUSED requests instead of shedding them — or on a voluntary rebalance
+    of paused batch-tier work. The failure ladder is always
+    resume → re-prefill from token history → retryable shed; adopted KV is
+    never zero-filled."""
+
+    enabled: bool = False
+    # shared, cross-replica NVMe directory: KV bytes land under
+    # <shared_nvme_path>/kv, resume manifests under
+    # <shared_nvme_path>/manifests. REQUIRED when enabled — per-replica
+    # scratch dirs would make the "durable" copy die with its donor.
+    shared_nvme_path: str = ""
+    # manifests (and their tier files) older than this are swept as
+    # abandoned at adoption/sweep time; 0 = never expire
+    manifest_ttl_s: float = 0.0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.enabled and not self.shared_nvme_path:
+            raise ValueError("serving.migration.enabled requires "
+                             "shared_nvme_path (a directory every replica "
+                             "can reach)")
+        if self.manifest_ttl_s < 0:
+            raise ValueError("serving.migration.manifest_ttl_s must be "
+                             ">= 0 (0 = never expire)")
+        return self
+
+
 class ServingConfig(DSTpuConfigModel):
     """``serving`` section: the request-lifecycle layer above
     ``InferenceEngineV2`` (``deepspeed_tpu/serving``) — bounded admission,
@@ -852,6 +888,7 @@ class ServingConfig(DSTpuConfigModel):
     router: RouterConfig = Field(default_factory=RouterConfig)
     fleet: FleetConfig = Field(default_factory=FleetConfig)
     slo: SLOConfig = Field(default_factory=SLOConfig)
+    migration: MigrationConfig = Field(default_factory=MigrationConfig)
 
     @model_validator(mode="after")
     def _check(self):

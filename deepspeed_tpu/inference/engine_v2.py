@@ -17,6 +17,7 @@ prefetch. A ``paged=False`` escape hatch keeps the dense per-slot cache
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, Optional, Sequence
 
@@ -43,7 +44,8 @@ class _PausedSeq:
     are NEGATIVE so they can never collide with the prefix cache's
     non-negative promote handles in a shared tier store."""
 
-    __slots__ = ("uid", "keys", "seen", "hist", "paused_t", "resuming")
+    __slots__ = ("uid", "keys", "seen", "hist", "paused_t", "resuming",
+                 "adopted", "durable", "manifest_path")
 
     def __init__(self, uid: int, keys, seen: int, hist):
         self.uid = uid
@@ -52,6 +54,14 @@ class _PausedSeq:
         self.hist = hist
         self.paused_t = time.perf_counter()
         self.resuming = False
+        # cross-replica migration state: `adopted` marks a record whose
+        # entries came from ANOTHER replica's manifest (its tier reads
+        # fault through the migrate site, not the resume site); `durable`/
+        # `manifest_path` are the donor-side crash backup to reclaim when
+        # the record dies locally (resume, cancel, expire)
+        self.adopted = False
+        self.durable = None
+        self.manifest_path = None
 
 
 class InferenceEngineV2:
@@ -265,6 +275,11 @@ class InferenceEngineV2:
         # store (prefix tiers off); the serving layer overrides from
         # serving.slo.pause_host_mb before the first pause
         self.pause_store_mb = 64.0
+        # shared migration namespace (serving.migration.shared_nvme_path,
+        # set by the serving layer before the first pause): gives the
+        # pause store an NVMe tier so paused KV can be exported durably
+        # and adopted by sibling replicas
+        self.migration_nvme_path = ""
         if self.prefix_cfg.enabled:
             from deepspeed_tpu.observability import get_registry
 
@@ -725,7 +740,13 @@ class InferenceEngineV2:
             from deepspeed_tpu.inference.kv_tier import KVTierStore
 
             self._tier_store = KVTierStore(
-                host_mb=float(self.pause_store_mb))
+                host_mb=float(self.pause_store_mb),
+                nvme_path=self.migration_nvme_path or "")
+        elif self.migration_nvme_path:
+            # store created before the serving layer set the shared path
+            # (or by prefix tiers without NVMe): late-attach; no-op when a
+            # swapper already exists
+            self._tier_store.attach_nvme(self.migration_nvme_path)
         if self._promote_step is None:
             self._promote_step = jax.jit(self._promote_impl,
                                          donate_argnums=(0,),
@@ -848,6 +869,119 @@ class InferenceEngineV2:
                               "seen_tokens": rec.seen})
         return True
 
+    # ---- cross-replica migration: durable export / adopt -----------------
+    def export_paused(self, uid: int, tag: str, shared_path: str,
+                      keep: bool = True) -> Optional[str]:
+        """Write a durable, portable resume manifest for a PAUSED uid onto
+        the shared migration namespace; returns the manifest path (None =
+        not exportable: unknown or mid-resume uid, no NVMe-backed store,
+        or the store's NVMe namespace is not the shared one). ``tag`` must
+        be fleet-unique — callers build it from the replica name +
+        incarnation + uid. With ``keep`` (the crash-backup path) the donor
+        retains its parked entries and reclaims the durable copy when the
+        record dies locally; ``keep=False`` (voluntary rebalance)
+        transfers ownership to the manifest, so the donor's local flush
+        leaves the durable files for the adopting sibling."""
+        rec = self._paused.get(uid)
+        if rec is None or rec.resuming:
+            return None
+        if rec.manifest_path is not None:
+            path = rec.manifest_path            # idempotent re-export
+            if not keep:
+                # a crash backup already exists; rebalance just transfers
+                # ownership — the donor's local flush must now LEAVE the
+                # durable files + manifest for the adopting sibling
+                rec.durable = None
+                rec.manifest_path = None
+            return path
+        store = self._tier_store
+        if store is None or store.swapper is None:
+            return None
+        if os.path.realpath(store.swapper.swap_dir) != os.path.realpath(
+                os.path.join(shared_path, "kv")):
+            # the store spills somewhere siblings cannot see (prefix tiers
+            # on a private path): a manifest would point at air
+            return None
+        from deepspeed_tpu.inference.kv_tier import write_manifest
+        from deepspeed_tpu.resilience.faults import get_injector
+
+        inj = get_injector()
+        t0 = time.perf_counter()
+        entries = store.export_durable(rec.keys, tag)
+        try:
+            if inj:
+                # the crash window the manifest protocol closes: KV bytes
+                # durable, manifest not yet committed → orphaned files the
+                # TTL sweep reclaims, never a manifest pointing at air
+                inj.on_pause_export(str(tag))
+            hist = rec.hist
+            payload = {
+                "uid": str(tag),
+                "seen_tokens": int(rec.seen),
+                "hist": ([] if hist is None
+                         else [int(t) for t in np.asarray(hist).tolist()]),
+                "entries": entries,
+            }
+            path = write_manifest(shared_path, payload)
+        except BaseException:
+            store.drop_durable(entries)
+            raise
+        if inj:
+            inj.maybe_tear_manifest(path, str(tag))
+        if keep:
+            rec.durable = entries
+            rec.manifest_path = path
+        bus = self._ebus
+        if bus.enabled:
+            bus.instant("kv_tier", "pause_export",
+                        args={"uid": int(uid), "tag": str(tag),
+                              "entries": len(entries), "keep": bool(keep),
+                              "ms": round((time.perf_counter() - t0) * 1e3,
+                                          3)})
+        return path
+
+    def adopt_paused(self, uid: int, payload: Dict,
+                     manifest_path: Optional[str] = None) -> None:
+        """Register another replica's exported pause record under the
+        LOCAL ``uid``: the manifest's durable entries become NVMe-tier
+        entries of this engine's pause store, and the uid becomes
+        resumable exactly like a locally-paused one — ``resume_request``
+        promotes KV this replica never produced, through the same
+        ``_flush_promotes`` fence. Raises on any validation failure
+        (missing/torn durable files, store without the shared namespace)
+        with the partial adopt fully unwound; the caller falls down the
+        re-prefill ladder. ``manifest_path`` (the claimed manifest) is
+        reclaimed when the record dies — after a successful resume, or
+        with the adopted entries on failure."""
+        if uid in self._paused or uid in self.state.sequences:
+            raise ValueError(f"adopt_paused: uid {uid} already live")
+        store = self._ensure_pause_store()
+        if store.swapper is None:
+            raise RuntimeError("adopt_paused requires a shared NVMe "
+                               "namespace (serving.migration)")
+        entries = payload.get("entries") or []
+        seen = int(payload.get("seen_tokens", 0))
+        if seen <= 0 or not entries:
+            raise ValueError("adopt_paused: empty manifest payload")
+        keys = []
+        for _ in entries:
+            keys.append(self._pause_key)
+            self._pause_key -= 1
+        store.adopt_durable(entries, keys)
+        hist = payload.get("hist") or None
+        rec = _PausedSeq(uid, keys, seen,
+                         None if hist is None
+                         else np.asarray(hist, np.int32))
+        rec.adopted = True
+        rec.manifest_path = manifest_path
+        self._paused[uid] = rec
+        bus = self._ebus
+        if bus.enabled:
+            bus.instant("kv_tier", "adopt",
+                        args={"uid": int(uid),
+                              "tag": str(payload.get("uid")),
+                              "entries": len(keys), "seen_tokens": seen})
+
     def flush_resumes(self) -> list:
         """Force pending resume uploads NOW and return the uids whose tier
         read failed (drained). The batcher calls this right after
@@ -902,7 +1036,14 @@ class InferenceEngineV2:
             for i, (key, fetch) in enumerate(zip(rec.keys, fetches)):
                 try:
                     if inj:
-                        inj.on_resume_read(store.tier_of(key) or "host")
+                        tier = store.tier_of(key) or "host"
+                        if rec.adopted:
+                            # adopted KV faults through the migration site
+                            # (a failed cross-replica read unwinds to the
+                            # re-prefill ladder, not a plain resume shed)
+                            inj.on_migrate_read(tier)
+                        else:
+                            inj.on_resume_read(tier)
                     parts = fetch.wait()
                 except Exception as e:
                     log_dist(f"kv tier: resume read failed for uid {uid} "
@@ -959,6 +1100,16 @@ class InferenceEngineV2:
         if self._tier_store is not None:
             for key in rec.keys:
                 self._tier_store.discard(key)
+            if rec.durable is not None:
+                # donor-side crash backup: a local resume (or terminal
+                # flush) makes the durable copy stale — reclaim it, or
+                # manifests would advertise requests that no longer exist
+                self._tier_store.drop_durable(rec.durable)
+        if rec.manifest_path is not None:
+            try:
+                os.remove(rec.manifest_path)
+            except OSError:
+                pass                    # claimed/reclaimed by a sibling
 
     def close(self) -> None:
         """Idempotent teardown of host-side resources the engine stands up

@@ -27,6 +27,9 @@ and the AIO swapper underneath are multi-client safe.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -37,10 +40,152 @@ from deepspeed_tpu.observability.events import get_bus
 from deepspeed_tpu.offload.swap import AsyncTensorSwapper, PinnedBufferPool
 from deepspeed_tpu.utils.logging import logger
 
-__all__ = ["KVTierStore", "KVFetch", "TIER_HOST", "TIER_NVME"]
+__all__ = ["KVTierStore", "KVFetch", "TIER_HOST", "TIER_NVME",
+           "ManifestError", "manifest_dir", "write_manifest",
+           "load_manifest", "claim_manifest", "sweep_manifests"]
 
 TIER_HOST = "host"
 TIER_NVME = "nvme"
+
+# ---------------------------------------------------------------------------
+# Portable resume manifests (cross-replica migration).
+#
+# A manifest makes a paused request's demoted KV ADDRESSABLE by a replica
+# that never produced it: the durable entry names on the shared NVMe
+# namespace, per-part (shape, dtype, offset) metadata, the sequence's
+# seen_tokens, and the full token history (the re-prefill fallback when the
+# KV bytes are gone). Commit is atomic (tmp + fsync + rename — the same
+# discipline as the warm-start cache's `adopt_meta` manifests) and the body
+# carries a sha256 over the canonical payload so a torn write is REJECTED
+# at load, never half-adopted. Adoption races are settled by
+# `claim_manifest`'s atomic rename: exactly one sibling wins.
+# ---------------------------------------------------------------------------
+
+MANIFEST_VERSION = 1
+_MANIFEST_SUBDIR = "manifests"
+
+
+class ManifestError(RuntimeError):
+    """A resume manifest is torn, corrupt, or from an unknown version."""
+
+
+def manifest_dir(shared_path: str) -> str:
+    """The manifest directory under a shared migration namespace."""
+    return os.path.join(shared_path, _MANIFEST_SUBDIR)
+
+
+def _canonical(payload: Dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def write_manifest(shared_path: str, payload: Dict) -> str:
+    """Atomically commit a resume manifest; returns its path.
+
+    ``payload["uid"]`` names the manifest file (it must be unique across
+    the fleet — callers use the router-scoped ruid plus an incarnation
+    token). The write is tmp + fsync + rename so a reader either sees a
+    complete manifest or none at all; the embedded sha256 catches the
+    remaining torn-write window (a reader mid-``rename`` on a non-POSIX
+    filesystem, or deliberate fault injection)."""
+    d = manifest_dir(shared_path)
+    os.makedirs(d, exist_ok=True)
+    body = _canonical(payload)
+    doc = json.dumps({"version": MANIFEST_VERSION,
+                      "sha256": hashlib.sha256(body).hexdigest(),
+                      "payload": payload}, sort_keys=True)
+    path = os.path.join(d, f"{payload['uid']}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    return path
+
+
+def load_manifest(path: str) -> Dict:
+    """Parse and verify one manifest; returns its payload.
+
+    Raises :class:`ManifestError` for torn/corrupt/version-skewed files
+    and ``FileNotFoundError`` for missing ones — callers treat both as
+    "no durable KV" and fall down the re-prefill ladder."""
+    with open(path, "r") as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise ManifestError(f"torn resume manifest {path}: {e}") from e
+    if not isinstance(doc, dict) or doc.get("version") != MANIFEST_VERSION:
+        raise ManifestError(f"resume manifest {path}: unknown version "
+                            f"{doc.get('version') if isinstance(doc, dict) else doc!r}")
+    payload = doc.get("payload")
+    want = doc.get("sha256")
+    if not isinstance(payload, dict) or \
+            hashlib.sha256(_canonical(payload)).hexdigest() != want:
+        raise ManifestError(f"resume manifest {path}: sha256 mismatch")
+    return payload
+
+
+def claim_manifest(path: str) -> Optional[str]:
+    """Atomically claim a manifest for adoption; returns the claimed path
+    or None if another sibling won the race (or the donor reclaimed it).
+    The claim is one ``os.rename`` — POSIX guarantees exactly one winner
+    when two siblings race the same manifest."""
+    claimed = path + ".claimed"
+    try:
+        os.rename(path, claimed)
+    except OSError:
+        return None
+    return claimed
+
+
+def sweep_manifests(shared_path: str, ttl_s: float,
+                    now: Optional[float] = None) -> int:
+    """Reclaim abandoned manifests (and the durable tier files they
+    address) older than ``ttl_s`` seconds; returns manifests removed.
+    ``ttl_s <= 0`` disables the sweep. Stray ``.tmp`` files from a writer
+    that died mid-commit are always removed past the TTL too. Torn
+    manifests past the TTL are unlinked even though their entry list is
+    unreadable — their orphaned tier files then age out with the
+    namespace (the drill asserts the shared dir drains)."""
+    if ttl_s <= 0:
+        return 0
+    d = manifest_dir(shared_path)
+    if not os.path.isdir(d):
+        return 0
+    now = time.time() if now is None else now
+    removed = 0
+    for fn in os.listdir(d):
+        path = os.path.join(d, fn)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue                      # raced another sweeper
+        if age <= ttl_s:
+            continue
+        is_manifest = fn.endswith(".json") or fn.endswith(".json.claimed")
+        if is_manifest:
+            try:
+                payload = load_manifest(path)
+            except (ManifestError, OSError):
+                payload = None              # torn: entry list unreadable
+            if payload is not None:
+                for ent in payload.get("entries", []):
+                    fp = os.path.join(shared_path, "kv",
+                                      str(ent["name"]).replace("/", "_")
+                                      + ".swp")
+                    try:
+                        os.remove(fp)
+                    except OSError:
+                        pass
+        try:
+            os.remove(path)
+        except OSError:
+            continue                        # raced another sweeper
+        if is_manifest:
+            removed += 1
+    return removed
 
 
 class _Entry:
@@ -285,6 +430,7 @@ class KVTierStore:
             "host_misses": 0, "nvme_misses": 0, "dropped": 0,
             "nvme_ttl_dropped": 0, "nvme_cap_dropped": 0,
             "batched_reads": 0,
+            "durable_exports": 0, "durable_adopts": 0,
         }
 
     # ------------------------------------------------------------------
@@ -623,6 +769,124 @@ class KVTierStore:
             e.wticket = None
         self.swapper.discard(e.name)
         self._set_bytes()
+
+    # ---- durable (incarnation-independent) addressing ----------------
+    def attach_nvme(self, nvme_path: str) -> None:
+        """Late-attach an NVMe tier (the shared migration namespace) to a
+        store created host-only: the pause path's private store can exist
+        before the serving layer learns ``serving.migration``'s path.
+        No-op when a swapper is already attached or the path is empty."""
+        if self.swapper is not None or not nvme_path:
+            return
+        self.swapper = AsyncTensorSwapper(nvme_path, namespace="kv",
+                                          pool=self.pool)
+        self._own_swapper = True
+
+    def export_durable(self, keys: Sequence[int], tag: str) -> List[Dict]:
+        """Write a DURABLE copy of each key's payload onto the NVMe
+        namespace under incarnation-independent names (``mig-<tag>-<i>``)
+        and return the entry descriptors a resume manifest embeds. The
+        local entries are untouched (the donor keeps its fast resume
+        path); every write ticket is WAITED before returning, so the
+        bytes are on disk before the caller commits the manifest —
+        a crash in between leaves orphaned files the TTL sweep reclaims,
+        never a manifest pointing at air. ``tag`` must be unique across
+        the fleet (router ruid + incarnation token). Raises on the first
+        failed copy after best-effort cleanup of the partial export."""
+        if self.swapper is None:
+            raise RuntimeError("durable export requires an NVMe tier "
+                               "(shared_nvme_path)")
+        out: List[Dict] = []
+        tickets = []
+        try:
+            for i, key in enumerate(keys):
+                e = self._host.get(key) or self._nvme.get(key)
+                if e is None:
+                    raise KeyError(f"kv tier: no entry for key {key}")
+                dname = f"mig-{tag}-{i}"
+                if key in self._host:
+                    blob = e.buf.data[:e.nbytes]
+                else:
+                    if e.wticket is not None:   # demote still in flight
+                        e.wticket.wait()
+                        e.wticket = None
+                    blob = self.swapper.swap_in(e.name)[:e.nbytes]
+                tickets.append(self.swapper.swap_out(dname, blob))
+                out.append({
+                    "name": dname,
+                    "nbytes": int(e.nbytes),
+                    "parts": [[n, list(shape), np.dtype(dt).str, int(off),
+                               int(nb)] for n, shape, dt, off, nb in e.parts],
+                })
+            for t in tickets:
+                t.wait()                        # durability before manifest
+        except BaseException:
+            for t in tickets:
+                try:
+                    t.wait()
+                except Exception:
+                    pass
+            self.drop_durable(out)
+            raise
+        self.counters["durable_exports"] += len(out)
+        if self._ebus.enabled:
+            self._ebus.instant("kv_tier", "durable_export",
+                               args={"tag": tag, "entries": len(out)})
+        return out
+
+    def adopt_durable(self, entries: Sequence[Dict],
+                      keys: Sequence[int]) -> None:
+        """Register durable entries ANOTHER replica's store exported as
+        NVMe-tier entries of this store, under fresh local ``keys``.
+        ``adopt_meta`` validates each backing file exists and is long
+        enough — a torn or swept file surfaces HERE (FileNotFoundError),
+        before any promote is attempted, and the partial adopt is fully
+        unwound (adopted siblings discarded, which removes their shared
+        files: ownership transferred at the manifest claim). After
+        adoption the entries behave exactly like locally-demoted NVMe
+        entries: promote via ``fetch_start``, reclaim via ``discard``."""
+        if self.swapper is None:
+            raise RuntimeError("durable adopt requires an NVMe tier "
+                               "(shared_nvme_path)")
+        if len(entries) != len(keys):
+            raise ValueError("adopt_durable: len(entries) != len(keys)")
+        done: List[int] = []
+        try:
+            for d, key in zip(entries, keys):
+                if self.has(key):
+                    raise KeyError(f"kv tier: key {key} already present")
+                self.swapper.adopt_meta(d["name"], (int(d["nbytes"]),),
+                                        np.uint8)
+                parts = [(str(n), tuple(int(s) for s in shape),
+                          np.dtype(dt), int(off), int(nb))
+                         for n, shape, dt, off, nb in d["parts"]]
+                e = _Entry(int(key), int(d["nbytes"]), parts)
+                e.name = str(d["name"])   # the durable name IS the address
+                e.touch = self._now()
+                self._nvme[int(key)] = e
+                self._nvme_used += e.nbytes
+                done.append(int(key))
+        except BaseException:
+            for key in done:
+                self.discard(key)
+            raise
+        self.counters["durable_adopts"] += len(done)
+        self._set_bytes()
+        if self._ebus.enabled:
+            self._ebus.instant("kv_tier", "durable_adopt",
+                               args={"entries": len(done)})
+
+    def drop_durable(self, entries: Sequence[Dict]) -> None:
+        """Best-effort removal of durable files this store exported (the
+        donor resumed locally, or an export failed partway): the files
+        are unlinked without ever having been store entries here."""
+        if self.swapper is None:
+            return
+        for d in entries:
+            try:
+                self.swapper.discard(str(d["name"]))
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     def clear(self) -> int:

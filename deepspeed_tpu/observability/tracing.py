@@ -106,7 +106,17 @@ class ServingMetrics:
         self.spec_acceptance_rate = r.gauge(
             "serving/spec_acceptance_rate",
             "lifetime accepted/drafted draft tokens")
+        # cross-replica migration (durable manifests on the shared tier)
+        self.migration_ms = r.histogram(
+            "serving/migration_ms",
+            "donor capture -> sibling adoption wall clock (ms)",
+            bounds=_LAT_BOUNDS)
+        self.reprefill_fallbacks = r.counter(
+            "serving/reprefill_fallbacks_total",
+            "migrated requests recovered by re-prefill (durable KV "
+            "missing or unreadable)")
         self._terminals: Dict[str, object] = {}
+        self._migrations: Dict[str, object] = {}
         self._sheds: Dict[str, object] = {}
         self._rejects: Dict[str, object] = {}
         self._qdepth_prio: Dict[str, object] = {}
@@ -159,6 +169,15 @@ class ServingMetrics:
                 "serving/preemptions_total",
                 "SLO preemptions (pause through the KV tier store)",
                 labels={"tier": tier})
+        return c
+
+    def migration(self, cause: str):
+        c = self._migrations.get(cause)
+        if c is None:
+            c = self._migrations[cause] = self.registry.counter(
+                "serving/migrations_total",
+                "requests re-homed onto a sibling replica",
+                labels={"cause": cause})
         return c
 
     def ttft_tier(self, tier: str):

@@ -50,6 +50,16 @@ Sites (the strings hooks pass to :meth:`FaultInjector.fire`):
   replica startup (cold-start / readiness-probe timeout drills);
   ``weight_load_io_error`` raises :class:`InjectedIOError` in the warm
   weight-load path so the cold fallback is exercised.
+* cross-replica-migration sites (durable pause export / sibling adopt,
+  drilled by ``tools/serve_drill.py --scenario crash-migrate``):
+  ``migrate_io_error`` raises :class:`InjectedIOError` in the adopted
+  record's tier read so the sibling must fall back to re-prefill from
+  token history (``site`` pins a tier: ``host`` | ``nvme``);
+  ``manifest_torn`` truncates a just-committed resume manifest so
+  adoption must reject it on the sha check (``site`` pins a uid); and
+  ``crash_during_pause_export`` dies between the KV demote and the
+  manifest commit — durable bytes with no manifest — so recovery must
+  re-prefill and still reclaim the orphaned tier files.
 """
 
 from __future__ import annotations
@@ -105,7 +115,9 @@ class FaultSpec:
              # SLO-preemption sites (pause/resume through the KV tier)
              "preempt_storm", "resume_io_error",
              # replica-lifecycle sites (Replica/FleetController hooks)
-             "replica_crash", "slow_start", "weight_load_io_error")
+             "replica_crash", "slow_start", "weight_load_io_error",
+             # cross-replica migration sites (durable pause export / adopt)
+             "migrate_io_error", "manifest_torn", "crash_during_pause_export")
 
     def __post_init__(self):
         if self.kind not in self.KINDS:
@@ -315,6 +327,55 @@ class FaultInjector:
                 self._record(spec, f"weight_load:{what}")
                 raise InjectedIOError(
                     f"injected weight-load IO failure ({what})")
+
+    # ---- cross-replica-migration faults -----------------------------------
+    def on_migrate_read(self, tier: str) -> None:
+        """Hook in the engine's ADOPTED-record tier read (cross-replica
+        resume promoting KV another replica demoted; one call per parked
+        block, before its ``wait()``): a ``migrate_io_error`` spec raises
+        so the adopt must unwind — the sibling falls back to re-prefill
+        from token history, NEVER decodes over zero-filled KV. ``site``
+        pins the failure to one tier (``host`` | ``nvme``)."""
+        for spec in self.faults:
+            if spec.kind == "migrate_io_error" \
+                    and spec.site in (None, tier) and self._take(spec):
+                self._record(spec, f"migrate:{tier}")
+                raise InjectedIOError(
+                    f"injected migrate tier-read failure ({tier})")
+
+    def maybe_tear_manifest(self, path: str, uid: str) -> bool:
+        """After a resume-manifest commit: a ``manifest_torn`` spec
+        truncates the file in place (a torn write the donor never saw),
+        so adoption must reject it on the sha/JSON check and fall back
+        to re-prefill. ``site`` pins the tear to one manifest uid.
+        Returns True if a tear fired."""
+        fired = False
+        for spec in self.faults:
+            if spec.kind == "manifest_torn" and spec.site in (None, uid) \
+                    and self._take(spec):
+                self._record(spec, f"manifest:{uid}")
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(size // 2, 1))
+                fired = True
+        return fired
+
+    def on_pause_export(self, uid: str) -> None:
+        """Hook between the durable KV demote and the manifest commit:
+        a ``crash_during_pause_export`` spec raises :class:`InjectedCrash`
+        (or hard-exits) at the exact window where KV bytes exist on the
+        shared namespace but no manifest points at them — recovery must
+        treat the export as absent (no manifest → re-prefill ladder) and
+        the orphaned tier files must still be reclaimed. ``site`` pins
+        the crash to one request uid."""
+        for spec in self.faults:
+            if spec.kind == "crash_during_pause_export" \
+                    and spec.site in (None, uid) and self._take(spec):
+                self._record(spec, f"pause_export:{uid}")
+                if spec.hard:
+                    os._exit(spec.exit_code)
+                raise InjectedCrash(
+                    f"injected crash during pause export ({uid})")
 
     def maybe_tear_checkpoint(self, tag_dir: str, step: int) -> bool:
         """After a save: damage the newest tag so verification must reject it.

@@ -41,14 +41,17 @@ queue/KV occupancy also stream through the monitor backends under
 
 from __future__ import annotations
 
+import itertools
+import os
 import signal
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.inference.kv_tier import sweep_manifests
 from deepspeed_tpu.inference.ragged import CapacityError
 from deepspeed_tpu.observability import (HEALTH_CODES, HistogramWindow,
                                          MonitorBridge, ServingMetrics)
@@ -57,13 +60,21 @@ from deepspeed_tpu.observability.trace import flight_dump
 from deepspeed_tpu.resilience.faults import InjectedIOError, get_injector
 from deepspeed_tpu.serving.manager import RequestManager
 from deepspeed_tpu.serving.request import (DECODING, PAUSED, PREFILLING,
-                                           TIERS, ServeRequest)
+                                           TIER_BATCH, TIERS, ServeRequest)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["STARTING", "READY", "DEGRADED", "DRAINING", "ContinuousBatcher"]
 
 STARTING, READY, DEGRADED, DRAINING = ("starting", "ready", "degraded",
                                        "draining")
+
+#: default migration-tag uniqueness for standalone batchers (no Replica
+#: wrapper to stamp name+incarnation): pid + process-lifetime sequence
+_MIG_SEQ = itertools.count()
+
+#: manifest TTL sweep cadence, in serving steps — the sweep is cheap
+#: (one listdir) but not free, and abandonment is measured in seconds
+_SWEEP_EVERY = 64
 
 
 class ContinuousBatcher:
@@ -117,6 +128,18 @@ class ContinuousBatcher:
         # from the serving config before the first pause forces creation
         if hasattr(self.engine, "pause_store_mb"):
             self.engine.pause_store_mb = float(self.cfg.slo.pause_host_mb)
+        # cross-replica migration: point the pause store's NVMe spill at
+        # the SHARED namespace (before the first pause forces creation, or
+        # late-attached if the store already exists host-only) so a paused
+        # request's KV is exportable to siblings
+        mig = getattr(self.cfg, "migration", None)
+        self._mig = mig if (mig is not None and mig.enabled) else None
+        if self._mig is not None \
+                and hasattr(self.engine, "migration_nvme_path"):
+            self.engine.migration_nvme_path = self._mig.shared_nvme_path
+        # fleet-unique donor tag prefix; a Replica overwrites this with
+        # "<name>-<incarnation>" so manifests survive its own restarts
+        self.migration_tag = f"solo{os.getpid()}n{next(_MIG_SEQ)}"
         # causal event bus (observability.tracing) — cached ref; the
         # singleton is mutated in place by configure_tracing
         self._ebus = get_bus()
@@ -153,12 +176,17 @@ class ContinuousBatcher:
             "tier_hit_requests": 0, "tier_promoted_blocks": 0,
             "spec_rounds": 0, "spec_draft_tokens": 0,
             "spec_accepted_tokens": 0, "resume_failures": 0,
+            "pause_exports": 0, "reprefill_fallbacks": 0,
+            "manifests_swept": 0,
         }
         # uids paused during the CURRENT step: a pause must hold for at
         # least one full step, or the same-step resume pass would undo the
         # demote it just paid for (and re-arm the starvation guard through
         # a pointless tier-store round-trip)
         self._just_paused: set = set()
+        # manifest TTL sweep tick — counts ALL steps (idle included: an
+        # idle replica is exactly the one with time to collect garbage)
+        self._sweep_tick = 0
 
     @classmethod
     def from_deepspeed_config(cls, engine, config, monitor=None, **kw):
@@ -334,7 +362,91 @@ class ContinuousBatcher:
         self.metrics.preemption(req.tier).inc()
         if self._trace:
             self.metrics.pause_ms.observe((self.clock() - t0) * 1e3)
+        if self._mig is not None:
+            self._export_manifest(req)
         return True
+
+    # ------------------------------------------------------------------
+    # cross-replica migration (durable manifests on the shared tier)
+    # ------------------------------------------------------------------
+    def _export_manifest(self, req: ServeRequest) -> None:
+        """Donor-side crash backup: write the portable resume manifest for
+        a freshly paused request onto the shared namespace. Best-effort —
+        a failed export (IO error, injected crash/tear) leaves the pause
+        itself intact, and a later crash falls down the re-prefill ladder
+        instead of resuming from durable KV."""
+        try:
+            path = self.engine.export_paused(
+                req.uid, f"{self.migration_tag}-{req.uid}",
+                self._mig.shared_nvme_path)
+        except Exception as e:
+            logger.warning(
+                f"serving: pause export failed uid={req.uid}: {e}")
+            return
+        if path is not None:
+            self.counters["pause_exports"] += 1
+
+    def adopt_inflight(self, donor: ServeRequest, payload=None,
+                       manifest_path: Optional[str] = None, *,
+                       deadline_s: Optional[float] = None,
+                       migrated_from: Optional[str] = None) -> ServeRequest:
+        """Adopt a request severed from (or exported by) another replica,
+        under a FRESH local uid.
+
+        With a manifest ``payload`` the donor's durable tier entries are
+        registered into this engine's pause store and the request lands
+        PAUSED — the normal resume pass promotes KV this replica never
+        produced, greedy tokens bit-identical. Without one it lands QUEUED
+        with the replay stream armed (re-prefill: recompute lost KV from
+        token history, never zero-fill). Raises
+        :class:`~deepspeed_tpu.serving.request.ShedError` when the queue
+        path refuses (draining / full); an engine-adopt failure unwinds
+        the manager ledger so the new uid is never exposed half-built."""
+        if payload is None:
+            return self.manager.adopt(donor, deadline_s=deadline_s,
+                                      migrated_from=migrated_from,
+                                      paused=False)
+        req = self.manager.adopt(donor, deadline_s=deadline_s,
+                                 migrated_from=migrated_from, paused=True)
+        try:
+            self.engine.adopt_paused(req.uid, payload,
+                                     manifest_path=manifest_path)
+        except BaseException:
+            self.manager.drop_adopted(req)
+            raise
+        return req
+
+    def export_paused_for_rebalance(
+            self, max_requests: int = 0) -> List[Tuple[ServeRequest, str]]:
+        """Voluntarily hand off paused batch-tier work: export each
+        candidate's manifest with ownership transferred (``keep=False``),
+        resolve it locally as silently rebalanced (no backpressure
+        signal), and return ``(request, manifest_path)`` pairs for the
+        router to adopt on an idle sibling. A request whose export fails
+        stays paused here — rebalance never loses work to hand it off."""
+        if self._mig is None:
+            return []
+        out: List[Tuple[ServeRequest, str]] = []
+        for req in self.manager.paused():
+            if req.tier != TIER_BATCH:
+                continue
+            if max_requests and len(out) >= max_requests:
+                break
+            if req.uid in self._just_paused:
+                continue       # same one-full-step hold as the resume pass
+            try:
+                path = self.engine.export_paused(
+                    req.uid, f"{self.migration_tag}-{req.uid}",
+                    self._mig.shared_nvme_path, keep=False)
+            except Exception as e:
+                logger.warning(f"serving: rebalance export failed "
+                               f"uid={req.uid}: {e}")
+                continue
+            if path is None:
+                continue
+            self.manager.migrate_out(req)
+            out.append((req, path))
+        return out
 
     def _resume_paused(self) -> None:
         """Rejoin paused requests when capacity allows — they are warm
@@ -343,7 +455,10 @@ class ContinuousBatcher:
         admission charges new work. Latency tier first, earliest pause
         first, up to ``slo.resume_max_per_step`` per step. A resume whose
         demoted entries were lost (tier spill, injected IO error) is shed
-        retryably as ``resume_io_error`` — never silently zero-filled."""
+        retryably as ``resume_io_error`` — never silently zero-filled; a
+        MIGRATED request falls back to re-prefill from token history
+        instead, so a sibling's bad tier read costs recompute, not the
+        request."""
         slo = self.cfg.slo
         if not (slo.enabled and slo.preempt):
             return
@@ -376,7 +491,16 @@ class ContinuousBatcher:
             lost = self.engine.flush_resumes()
             if req.uid in lost:
                 self.counters["resume_failures"] += 1
-                mgr.shed(req, "resume_io_error")
+                if req.migrated_from is not None:
+                    # adopted KV unreadable mid-promote: the engine already
+                    # unwound the resume and dropped the adopted entries —
+                    # recompute from token history instead of shedding work
+                    # a sibling already paid for (recompute, never zero-fill)
+                    mgr.requeue_for_replay(req)
+                    self.counters["reprefill_fallbacks"] += 1
+                    self.metrics.reprefill_fallbacks.inc()
+                else:
+                    mgr.shed(req, "resume_io_error")
                 continue
             if not ok:
                 continue       # capacity race; still parked, retried later
@@ -506,7 +630,7 @@ class ContinuousBatcher:
                 # a spec round schedules up to 1 + K tokens (drafts verify
                 # into KV even when rejected) — plan for the worst case
                 return 1 + self._spec_cap(r) if spec else 1
-            return min(chunk, r.prompt_len - r.prefilled)
+            return min(chunk, r.feed_len - r.prefilled)
 
         while batch and not self.engine.state.can_schedule_batch(
                 [r.uid for r in batch], [demand(r) for r in batch]):
@@ -562,7 +686,22 @@ class ContinuousBatcher:
         if req.state == PREFILLING:
             req.prefilled += fed
             self.counters["prefill_tokens"] += fed
-            if req.prefilled < req.prompt_len:
+            if req.prefilled < req.feed_len:
+                return
+            if req.replay is not None:
+                # re-prefill complete: the lost KV is recomputed. These
+                # final logits predict the already-known last generated
+                # token — DISCARD them (nothing is re-emitted to the
+                # client) and continue decoding from that token
+                req.replay = None
+                req.prefilled = req.prompt_len
+                req.state = DECODING
+                if req.trace_id is not None and self._ebus.enabled:
+                    self._ebus.async_instant(
+                        "request", "request", req.trace_id,
+                        args={"subsys": "batcher", "what": "replay_done",
+                              "uid": req.uid,
+                              "generated": len(req.generated)})
                 return
             req.state = DECODING
             if req.trace_id is not None and self._ebus.enabled:
@@ -602,6 +741,14 @@ class ContinuousBatcher:
         inj = get_injector()
         self.manager.expire()
         self._just_paused.clear()
+        self._sweep_tick += 1
+        if self._mig is not None and self._mig.manifest_ttl_s > 0 \
+                and self._sweep_tick % _SWEEP_EVERY == 0:
+            try:
+                self.counters["manifests_swept"] += sweep_manifests(
+                    self._mig.shared_nvme_path, self._mig.manifest_ttl_s)
+            except OSError as e:
+                logger.warning(f"serving: manifest sweep failed: {e}")
         if self.health != DRAINING:
             self._shed_over_watermarks(
                 forced=bool(inj) and inj.shed_forced(),
@@ -641,7 +788,8 @@ class ContinuousBatcher:
             uids.append(r.uid)
             chunks.append(np.asarray([r.next_token], np.int32)
                           if r.state == DECODING
-                          else r.prompt[r.prefilled:r.prefilled + chunk])
+                          else r.feed_source[r.prefilled:r.prefilled
+                                             + chunk])
         failed = None
         try:
             inj.on_serving_step(
@@ -978,6 +1126,7 @@ class ContinuousBatcher:
                   "expired", "cancelled", "paused", "resumed"):
             events.append((f"serving/{k}", float(m.counters[k]), s))
         for k in ("engine_steps", "step_failures", "decode_tokens",
-                  "prefill_tokens", "degraded_entries", "resume_failures"):
+                  "prefill_tokens", "degraded_entries", "resume_failures",
+                  "reprefill_fallbacks"):
             events.append((f"serving/{k}", float(self.counters[k]), s))
         return events

@@ -29,6 +29,12 @@ routing and request bookkeeping; the controller owns replica LIFECYCLE:
   is used); scale-down drains, waits for ``drained``, then removes —
   queued requests migrate, in-flight ones finish.
 
+* **paused-work rebalance** — when cross-replica migration is configured
+  (``serving.migration``), each poll also moves paused batch-tier work
+  from a pressured replica onto a READY idle sibling through the shared
+  KV tier (``router.rebalance_paused``) — preempted work resumes on idle
+  capacity instead of waiting behind the donor's latency traffic.
+
 * **rolling weight swaps** — ``rolling_swap()`` walks the pool one
   replica at a time: drain-migrate, build a replacement (new weights via
   the factory), READY-probe, readmit, close the old incarnation. The
@@ -89,7 +95,7 @@ class FleetController:
         self.counters: Dict[str, int] = {
             "polls": 0, "deaths": 0, "hung_interrupts": 0, "respawns": 0,
             "respawn_failures": 0, "scale_ups": 0, "scale_downs": 0,
-            "rolling_swaps": 0, "probe_failures": 0,
+            "rolling_swaps": 0, "probe_failures": 0, "rebalances": 0,
         }
         # hysteresis state
         self._up_streak = 0
@@ -154,7 +160,30 @@ class FleetController:
                 else:
                     actions["interrupted"].append(rep.name)
         self._autoscale(actions)
+        actions["rebalanced"] = self._rebalance_paused()
         return actions
+
+    def _rebalance_paused(self) -> Optional[Dict]:
+        """One rebalance decision per poll: when a replica is sitting on
+        paused batch-tier work (preempted under pressure, parked in the
+        shared tier) and a DIFFERENT replica is READY and idle, hand the
+        work over through the router's migration ladder. A donor with
+        paused work always has ``active > 0``, so it can never be its own
+        idle target; no-op when migration is not configured (the donor
+        exports nothing)."""
+        reps = [r for r in self.router._snapshot() if r.routable]
+        donors = [r for r in reps if r.stats.get("paused_batch", 0) > 0]
+        idle = [r for r in reps if r.stats["health"] == READY
+                and r.stats["queue_depth"] == 0 and r.stats["active"] == 0]
+        if not donors or not idle:
+            return None
+        donor = max(donors,
+                    key=lambda r: r.stats.get("paused_batch", 0))
+        res = self.router.rebalance_paused(donor.name,
+                                           max_requests=len(idle))
+        if res.get("migrated"):
+            self.counters["rebalances"] += res["migrated"]
+        return res
 
     def _autoscale(self, actions: Dict) -> None:
         cfg = self.cfg

@@ -93,7 +93,7 @@ class RequestManager:
         self.counters: Dict[str, int] = {
             "submitted": 0, "rejected": 0, "admitted": 0, "completed": 0,
             "shed": 0, "expired": 0, "cancelled": 0, "paused": 0,
-            "resumed": 0,
+            "resumed": 0, "adopted": 0, "rebalanced": 0, "reprefills": 0,
         }
         self.shed_reasons: Dict[str, int] = {}
 
@@ -301,6 +301,121 @@ class RequestManager:
                                            "what": "resume", "uid": req.uid,
                                            "tier": req.tier,
                                            "pauses": req.pause_count})
+
+    # ------------------------------------------------------------------
+    # cross-replica migration transitions
+    # ------------------------------------------------------------------
+    def adopt(self, donor: ServeRequest, *,
+              deadline_s: Optional[float] = None,
+              migrated_from: Optional[str] = None,
+              paused: bool = True) -> ServeRequest:
+        """Register a request migrated from a sibling replica under a
+        FRESH local uid (uid namespaces overlap across managers; the
+        router-scoped ruid is what survives the move). ``paused=True``
+        lands the request directly in ``active`` as PAUSED — its durable
+        KV was adopted by the engine, and the normal budget-gated resume
+        path promotes it. ``paused=False`` arms the re-prefill fallback
+        (:meth:`ServeRequest.prepare_replay`) and queues the request for
+        ordinary admission — recompute, never zero-fill; raises
+        ``queue_full``/``draining`` like :meth:`submit` so the router can
+        try the next sibling. Donor span timestamps are kept (one
+        monotonic clock domain per host) so e2e latency stays honest
+        across the move."""
+        if not paused and self._closed_reason is not None:
+            raise ShedError("draining", retryable=True,
+                            retry_after_s=self.current_retry_after(
+                                donor.tier),
+                            detail=self._closed_reason)
+        if not paused and len(self.queue) >= self.max_queue_depth:
+            raise ShedError("queue_full", retryable=True,
+                            retry_after_s=self.current_retry_after(
+                                donor.tier),
+                            detail=f"depth {len(self.queue)} >= "
+                                   f"{self.max_queue_depth}")
+        now = self.clock()
+        req = ServeRequest(
+            uid=self._next_uid, prompt=donor.prompt,
+            max_new_tokens=int(donor.max_new_tokens),
+            priority=int(donor.priority),
+            tier=donor.tier if donor.tier in TIERS else self.default_tier,
+            deadline=(None if deadline_s is None
+                      else now + float(deadline_s)),
+            submitted_at=donor.submitted_at or now,
+            trace_id=donor.trace_id)
+        self._next_uid += 1
+        req.prefilled = int(donor.prefilled)
+        req.generated = list(donor.generated)
+        req.next_token = donor.next_token
+        req.admitted_at = donor.admitted_at
+        req.first_token_at = donor.first_token_at
+        req.last_token_at = donor.last_token_at
+        req.pause_count = int(donor.pause_count)
+        req.progress_at_last_pause = int(donor.progress_at_last_pause)
+        req.migrated_from = migrated_from
+        self.counters["submitted"] += 1
+        self.counters["adopted"] += 1
+        if paused:
+            req.state = PAUSED
+            req.paused_at = donor.paused_at or now
+            self.active[req.uid] = req
+            self.counters["admitted"] += 1
+        else:
+            req.prepare_replay()
+            req.state = QUEUED
+            self._queued_uids.add(req.uid)  # membership BEFORE visibility
+            self.queue.append(req)
+        if req.trace_id is not None and self._ebus.enabled:
+            # the donor's track ended at its shed; the SAME id re-opens
+            # here so one /v1/trace chain shows export→adopt→resume
+            self._ebus.async_begin("request", "request", req.trace_id,
+                                   args={"subsys": "serving",
+                                         "what": "adopt", "uid": req.uid,
+                                         "from": migrated_from,
+                                         "replay": req.replay is not None})
+        return req
+
+    def drop_adopted(self, req: ServeRequest) -> None:
+        """Unwind a failed adopt registration (the engine rejected the
+        manifest's durable entries): the uid was never exposed outside
+        the worker thread, so it simply vanishes — no terminal record;
+        the caller falls down the re-prefill ladder instead."""
+        self.active.pop(req.uid, None)
+        if req in self.queue:
+            self.queue.remove(req)
+        self._queued_uids.discard(req.uid)
+
+    def migrate_out(self, req: ServeRequest) -> None:
+        """A live PAUSED request leaves this manager for a sibling
+        (voluntary rebalance): terminal locally as a silent ``rebalanced``
+        shed — WITHOUT the overload pressure signal a real shed feeds the
+        Retry-After hint — while the router rewrites the route so the
+        client-facing ruid resolves through the adopting sibling."""
+        req.finish_reason = "rebalanced"
+        self._finish(req, SHED)
+        self.counters["rebalanced"] += 1
+        self.shed_reasons["rebalanced"] = \
+            self.shed_reasons.get("rebalanced", 0) + 1
+
+    def requeue_for_replay(self, req: ServeRequest) -> None:
+        """Fall a live (active) request back to re-prefill: its KV is
+        unrecoverable (migrate/resume tier read failed after adoption)
+        but its token history is intact. The request re-enters the queue
+        HEAD with the replay stream armed — it already held capacity
+        once, so it re-admits before newcomers."""
+        req.prepare_replay()
+        req.state = QUEUED
+        req.paused_at = None
+        self._queued_uids.add(req.uid)      # next home before leaving
+        self.queue.appendleft(req)
+        self.active.pop(req.uid, None)
+        self.counters["reprefills"] += 1
+        if req.trace_id is not None and self._ebus.enabled:
+            self._ebus.async_instant("request", "request", req.trace_id,
+                                     args={"subsys": "serving",
+                                           "what": "reprefill",
+                                           "uid": req.uid,
+                                           "generated":
+                                               len(req.generated)})
 
     def paused(self) -> List[ServeRequest]:
         """Paused requests in resume order: latency tier first, earliest

@@ -30,7 +30,10 @@ same source:
 * **streaming framing** — Server-Sent Events over chunked
   transfer-encoding: ``event: token`` per generated token, a final
   ``event: end`` carrying the full terminal record, ``event: migrated``
-  when the router moved a queued request off a draining replica.
+  when the router moved the request to a sibling replica — off a
+  draining replica's queue, or mid-flight through the shared KV tier
+  after a crash or a voluntary rebalance (the terminal record then
+  carries ``migrated_from``).
 """
 
 from __future__ import annotations
@@ -200,6 +203,9 @@ def terminal_record(req: ServeRequest, *, state: Optional[str] = None,
         "usage": {"prompt_tokens": req.prompt_len,
                   "completion_tokens": len(req.generated)},
         "span": req.span(),
+        # donor replica when the request was re-homed here (crash or
+        # rebalance migration); None for a request that never moved
+        "migrated_from": req.migrated_from,
         "error": None if err is None else {
             "reason": err.reason, "retryable": err.retryable,
             "retry_after_s": err.retry_after_s},

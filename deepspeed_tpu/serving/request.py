@@ -119,6 +119,13 @@ class ServeRequest:
     pause_count: int = 0
     progress_at_last_pause: int = -1
     paused_at: Optional[float] = None
+    # cross-replica migration bookkeeping: ``migrated_from`` names the
+    # donor replica for a request adopted here (crash or rebalance);
+    # ``replay`` holds the engine-side re-prefill token stream when the
+    # durable KV was unavailable — already-emitted tokens are recomputed
+    # into KV, never re-emitted (see :meth:`prepare_replay`)
+    migrated_from: Optional[str] = None
+    replay: Optional[np.ndarray] = None
     # terminal bookkeeping
     finish_reason: str = ""            # length | eos | shed slug | expired
     error: Optional[ShedError] = None
@@ -171,6 +178,38 @@ class ServeRequest:
             tier_rank = len(TIERS)
         return (-tier_rank, self.deadline is not None,
                 -self.remaining_tokens, self.shed_key())
+
+    @property
+    def feed_source(self) -> np.ndarray:
+        """The token stream the prefill plan feeds: the replay stream (a
+        re-prefill recomputing lost KV) when armed, else the prompt."""
+        return self.replay if self.replay is not None else self.prompt
+
+    @property
+    def feed_len(self) -> int:
+        """Prefill target length for the current feed source."""
+        return int(len(self.replay)) if self.replay is not None \
+            else self.prompt_len
+
+    def prepare_replay(self) -> None:
+        """Arm the re-prefill fallback: the KV is gone (crash without a
+        durable manifest, or a migrate/resume tier read failed) but the
+        token history is not. The replay stream — prompt plus all but the
+        last generated token — is recomputed into KV, then decoding
+        continues from the last generated token; the replay's final
+        logits predict that already-known token and are DISCARDED.
+        Client-facing ``prompt``/``generated`` are untouched (nothing is
+        re-emitted). With nothing generated yet this is a plain prefill
+        restart."""
+        self.prefilled = 0
+        if self.generated:
+            self.replay = np.concatenate(
+                [self.prompt,
+                 np.asarray(self.generated[:-1], np.int32)]).astype(np.int32)
+            self.next_token = int(self.generated[-1])
+        else:
+            self.replay = None
+            self.next_token = None
 
     def pause_allowed(self) -> bool:
         """Starvation guard: a request may be paused again only after it

@@ -43,6 +43,7 @@ would answer for uid 5 of the dead one.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import signal
 import threading
@@ -52,12 +53,16 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+from deepspeed_tpu.inference.kv_tier import (ManifestError, claim_manifest,
+                                             load_manifest)
 from deepspeed_tpu.observability.events import SAMPLED_OUT, get_bus
 from deepspeed_tpu.observability.trace import flight_dump
 from deepspeed_tpu.resilience.faults import get_injector
 from deepspeed_tpu.serving.batcher import DEGRADED, DRAINING, READY
 from deepspeed_tpu.serving.protocol import terminal_record
-from deepspeed_tpu.serving.request import CANCELLED, ServeRequest, ShedError
+from deepspeed_tpu.serving.request import (CANCELLED, PAUSED, QUEUED,
+                                           TIER_BATCH, ServeRequest,
+                                           ShedError)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["Replica", "ReplicaRouter"]
@@ -91,6 +96,11 @@ class Replica:
         self.inbox: "queue.Queue" = queue.Queue()
         self.paused = False            # test hook: commands yes, steps no
         self.incarnation = next(_INCARNATIONS)
+        # fleet-unique manifest tag: migration manifests this replica
+        # writes must survive its own respawn (batcher uids restart from
+        # 0 under a new incarnation; the tag never collides)
+        if hasattr(batcher, "migration_tag"):
+            batcher.migration_tag = f"{name}-{self.incarnation}"
         self.crash_error: Optional[BaseException] = None
         self._subs: Dict[int, _Sub] = {}
         self._stop = threading.Event()
@@ -231,6 +241,29 @@ class Replica:
         In-flight requests stay and finish under the drain."""
         return self._command("drain", reason)
 
+    def adopt(self, donor: ServeRequest, payload: Optional[Dict] = None,
+              manifest_path: Optional[str] = None, *,
+              deadline_s: Optional[float] = None,
+              migrated_from: Optional[str] = None,
+              events: Optional["queue.Queue"] = None,
+              sent: int = 0) -> int:
+        """Adopt a migrated request through the worker (see
+        :meth:`ContinuousBatcher.adopt_inflight`). The re-attached
+        subscriber resumes at token index ``sent`` so nothing the donor
+        already delivered is republished. Returns the local uid."""
+        return self._command("adopt", dict(
+            donor=donor, payload=payload, manifest_path=manifest_path,
+            deadline_s=deadline_s, migrated_from=migrated_from,
+            events=events, sent=sent))
+
+    def request_rebalance(self, max_requests: int = 0) -> List[Tuple]:
+        """Worker-side voluntary handoff of paused batch-tier work (see
+        :meth:`ContinuousBatcher.export_paused_for_rebalance`). Returns
+        ``(request, manifest_path, events, sent)`` tuples with the
+        subscriptions detached, so the donor-side terminal stays silent
+        and the router re-attaches the stream on the adopting sibling."""
+        return self._command("rebalance", max_requests)
+
     def report(self) -> Dict:
         """``serving_report()`` taken inside the worker loop, so it never
         races a step (falls back to a direct call once the worker is
@@ -242,19 +275,24 @@ class Replica:
     def resolve(self, uid: int) -> Optional[str]:
         return self._command("resolve", uid)
 
-    def capture_dead(self) -> List[Tuple[ServeRequest,
-                                         Optional["queue.Queue"]]]:
+    def capture_dead(self) -> List[Tuple]:
         """Post-mortem capture after the worker thread died (crash path).
         Only legal on a DEAD replica — the batcher is single-threaded by
         contract, and this walks it from the caller's thread. Fails any
-        commands stranded in the inbox, detaches the queued-but-unstarted
+        commands stranded in the inbox, detaches queued AND in-flight
         requests (with their event queues) for the router to re-home,
-        terminal-izes EVERYTHING still on the dead batcher as
-        ``replica_crash`` sheds (queued copies stay silent — the router
-        re-homes them; in-flight requests lost their KV with the worker,
-        so their subscribers get the shed END event), and tears the
-        batcher down. Every uid the dead replica ever admitted keeps
-        resolving terminal through its (soon retired) ledger."""
+        terminal-izes everything still on the dead batcher as
+        ``replica_crash`` sheds (silent — the subscriptions are detached;
+        the router either re-homes each request or resolves its stream
+        itself), and tears the batcher down. A PAUSED request's durable
+        manifest is re-exported with ownership transferred, so the local
+        teardown leaves the shared-tier files for the adopting sibling —
+        and a pause whose backup write failed gets a fresh export here.
+        Every uid the dead replica ever admitted keeps resolving terminal
+        through its (soon retired) ledger.
+
+        Returns ``(request, events, pre_crash_state, manifest_path,
+        tokens_already_sent)`` tuples."""
         if self.alive:
             raise RuntimeError(
                 f"replica {self.name} worker still alive — capture_dead "
@@ -269,10 +307,27 @@ class Replica:
                     "replica_unavailable", retryable=True,
                     retry_after_s=1.0, detail=f"{self.name} crashed"))
         m = self.batcher.manager
+        mig = getattr(self.batcher, "_mig", None)
         captured = []
         for req in list(m.queue):
             sub = self._subs.pop(req.uid, None)
-            captured.append((req, None if sub is None else sub.events))
+            captured.append((req, None if sub is None else sub.events,
+                             QUEUED, None, 0))
+        for req in list(m.active.values()):
+            sub = self._subs.pop(req.uid, None)
+            manifest = None
+            if mig is not None and req.state == PAUSED:
+                try:
+                    manifest = self.batcher.engine.export_paused(
+                        req.uid,
+                        f"{self.batcher.migration_tag}-{req.uid}",
+                        mig.shared_nvme_path, keep=False)
+                except Exception as e:
+                    logger.warning(f"serving: dead-replica export of "
+                                   f"uid={req.uid} failed: {e!r}")
+            captured.append((req, None if sub is None else sub.events,
+                             req.state, manifest,
+                             0 if sub is None else sub.sent))
         for req in list(m.queue):
             m.shed(req, "replica_crash")
         for req in list(m.active.values()):
@@ -361,6 +416,30 @@ class Replica:
                 self.batcher.begin_drain(payload)
                 self._update_stats()
                 fut.set_result(captured)
+            elif kind == "adopt":
+                events = payload.pop("events")
+                sent = payload.pop("sent")
+                req = self.batcher.adopt_inflight(
+                    payload.pop("donor"), payload.pop("payload"),
+                    payload.pop("manifest_path"), **payload)
+                if events is not None:
+                    sub = _Sub(events)
+                    # the donor already delivered these tokens; this
+                    # publisher starts where the donor's stopped
+                    sub.sent = min(int(sent), len(req.generated))
+                    self._subs[req.uid] = sub
+                self._update_stats()
+                fut.set_result(req.uid)
+            elif kind == "rebalance":
+                out = []
+                for req, path in \
+                        self.batcher.export_paused_for_rebalance(payload):
+                    sub = self._subs.pop(req.uid, None)
+                    out.append((req, path,
+                                None if sub is None else sub.events,
+                                0 if sub is None else sub.sent))
+                self._update_stats()
+                fut.set_result(out)
             elif kind == "report":
                 fut.set_result(self.batcher.serving_report())
             elif kind == "resolve":
@@ -418,6 +497,11 @@ class Replica:
             # per-SLO-tier backlog: the autoscaler's pressure signal
             # (batch-tier depth alone must not scale the fleet up)
             "queue_depth_by_tier": m.queue_depth_by_tier(),
+            # paused batch-tier work: the fleet's rebalance-donor signal
+            # (an idle sibling can adopt it through the shared tier)
+            "paused_batch": sum(1 for r in m.active.values()
+                                if r.state == PAUSED
+                                and r.tier == TIER_BATCH),
         }
 
 
@@ -469,8 +553,16 @@ class ReplicaRouter:
         self.counters: Dict[str, int] = {              #: guarded_by: _lock
             "routed": 0, "failover": 0, "rejected": 0, "migrated": 0,
             "migration_failed": 0, "drains": 0, "crash_failovers": 0,
-            "readmits": 0,
+            "readmits": 0, "adopts": 0, "adopt_failures": 0,
+            "reprefill_failovers": 0, "torn_manifests": 0, "rebalances": 0,
         }
+        # migration instruments ride the first replica's ServingMetrics so
+        # the router's counters land in the same registry the pool's
+        # /metrics endpoint scrapes (None with a metrics-less batcher:
+        # the router still counts, it just doesn't export)
+        self.metrics = getattr(
+            getattr(replicas[0], "batcher", None), "metrics", None) \
+            if replicas else None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -550,36 +642,7 @@ class ReplicaRouter:
                 with self._lock:
                     self.counters["failover"] += 1
                 continue
-            with self._lock:
-                if _ruid is None:
-                    ruid = self._next_ruid
-                    self._next_ruid += 1
-                    self._routes[ruid] = _Route(rep.name, rep.incarnation,
-                                                uid, events)
-                    self._route_order.append(ruid)
-                    self.counters["routed"] += 1
-                    self._evict_terminal_routes()
-                else:                # migration keeps the client-facing uid
-                    ruid = _ruid
-                    route = self._routes.get(ruid)
-                    if route is None:
-                        # evicted between drain-capture and re-home (the
-                        # draining replica sheds the capture into its done
-                        # ledger, making the route eviction-eligible):
-                        # re-insert under the SAME ruid so the client's
-                        # uid keeps resolving through the migration
-                        route = _Route(rep.name, rep.incarnation, uid,
-                                       events)
-                        self._routes[ruid] = route
-                        self._route_order.append(ruid)
-                    else:
-                        self._by_loc.pop(
-                            (route.replica, route.inc, route.uid), None)
-                        route.replica, route.uid = rep.name, uid
-                        route.inc = rep.incarnation
-                    route.migrations += 1
-                self._by_loc[(rep.name, rep.incarnation, uid)] = ruid
-            return ruid
+            return self._record_route(rep, uid, events, _ruid)
         with self._lock:
             self.counters["rejected"] += 1
         if last is None:
@@ -589,6 +652,42 @@ class ReplicaRouter:
         raise ShedError(last.reason, retryable=True,
                         retry_after_s=max(hint, last.retry_after_s or 0.0),
                         detail=f"all {attempts} routable replicas refused")
+
+    def _record_route(self, rep: Replica, uid: int, events,
+                      _ruid: Optional[int]) -> int:
+        """Insert (``_ruid=None``) or rewrite (migration keeps the
+        client-facing uid) the route for a request that just landed on
+        ``rep`` as ``uid``; returns the router-scoped uid."""
+        with self._lock:
+            if _ruid is None:
+                ruid = self._next_ruid
+                self._next_ruid += 1
+                self._routes[ruid] = _Route(rep.name, rep.incarnation,
+                                            uid, events)
+                self._route_order.append(ruid)
+                self.counters["routed"] += 1
+                self._evict_terminal_routes()
+            else:                # migration keeps the client-facing uid
+                ruid = _ruid
+                route = self._routes.get(ruid)
+                if route is None:
+                    # evicted between drain-capture and re-home (the
+                    # draining replica sheds the capture into its done
+                    # ledger, making the route eviction-eligible):
+                    # re-insert under the SAME ruid so the client's
+                    # uid keeps resolving through the migration
+                    route = _Route(rep.name, rep.incarnation, uid,
+                                   events)
+                    self._routes[ruid] = route
+                    self._route_order.append(ruid)
+                else:
+                    self._by_loc.pop(
+                        (route.replica, route.inc, route.uid), None)
+                    route.replica, route.uid = rep.name, uid
+                    route.inc = rep.incarnation
+                route.migrations += 1
+            self._by_loc[(rep.name, rep.incarnation, uid)] = ruid
+        return ruid
 
     def _route_loc(self, ruid: int) -> Optional[Tuple[str, int, int]]:
         """Snapshot (replica, incarnation, uid) under the lock: a
@@ -674,14 +773,24 @@ class ReplicaRouter:
         return {"replica": name, "captured": len(captured),
                 "migrated": migrated, "failed": failed}
 
-    def _migrate(self, rep: Replica, captured) -> Tuple[int, int]:
-        """Re-home captured (request, events) pairs onto siblings of
-        ``rep``. Each migrated request keeps its router uid, priority,
-        remaining deadline, and event stream. Returns (migrated, failed);
-        failures resolve as retryable sheds on the event stream."""
+    def _migrate(self, rep: Replica, captured,
+                 cause: str = "crash") -> Tuple[int, int]:
+        """Re-home captured requests onto siblings of ``rep``. Each
+        migrated request keeps its router uid, priority, remaining
+        deadline, and event stream. Queued requests resubmit as plain
+        routes; in-flight ones walk the recovery ladder
+        (:meth:`_adopt_on_sibling`): durable-manifest adoption (resume on
+        the sibling, greedy tokens bit-identical), else re-prefill from
+        token history — recompute, never zero-fill — and only then a
+        retryable shed on the event stream. Returns (migrated, failed)."""
         name = rep.name
         migrated = failed = 0
-        for req, events in captured:
+        for item in captured:
+            if len(item) == 2:         # drain capture: queued-only pairs
+                req, events = item
+                pre_state, manifest, sent = QUEUED, None, 0
+            else:
+                req, events, pre_state, manifest, sent = item
             ruid = self._ruid_for(name, rep.incarnation, req.uid)
             remaining = (None if req.deadline is None
                          else req.deadline - self.clock())
@@ -692,25 +801,31 @@ class ReplicaRouter:
                     raise ShedError("draining", retryable=True,
                                     retry_after_s=1.0,
                                     detail="migration disabled")
-                # a traced request keeps its id across the migration; an
-                # untraced one (sampled out, or submitted while tracing
-                # was off) must not get minted a fresh mid-life track
-                mig_trace = (req.trace_id if req.trace_id is not None
-                             else (SAMPLED_OUT if get_bus().enabled
-                                   else None))
-                new_ruid = self.submit(
-                    req.prompt, max_new_tokens=req.max_new_tokens,
-                    deadline_s=remaining, priority=req.priority,
-                    tier=req.tier, events=events, trace_id=mig_trace,
-                    _exclude=(name,),
-                    _ruid=None if ruid is None else ruid)
+                if pre_state != QUEUED:
+                    new_ruid = self._adopt_on_sibling(
+                        rep, req, events, manifest, ruid, remaining,
+                        cause, sent)
+                else:
+                    # a traced request keeps its id across the migration;
+                    # an untraced one (sampled out, or submitted while
+                    # tracing was off) must not get minted a fresh
+                    # mid-life track
+                    mig_trace = (req.trace_id if req.trace_id is not None
+                                 else (SAMPLED_OUT if get_bus().enabled
+                                       else None))
+                    new_ruid = self.submit(
+                        req.prompt, max_new_tokens=req.max_new_tokens,
+                        deadline_s=remaining, priority=req.priority,
+                        tier=req.tier, events=events, trace_id=mig_trace,
+                        _exclude=(name,),
+                        _ruid=None if ruid is None else ruid)
                 migrated += 1
                 bus = get_bus()
                 if req.trace_id is not None and bus.enabled:
                     bus.async_instant("request", "request", req.trace_id,
                                       args={"subsys": "router",
                                             "what": "migrated",
-                                            "from": name})
+                                            "from": name, "cause": cause})
                 if events is not None:
                     # announced only once the sibling really took it (a
                     # refused migration must read as a shed, not a move);
@@ -737,6 +852,124 @@ class ReplicaRouter:
             self.counters["migrated"] += migrated
             self.counters["migration_failed"] += failed
         return migrated, failed
+
+    def _adopt_on_sibling(self, donor: Replica, req: ServeRequest, events,
+                          manifest: Optional[str], ruid: Optional[int],
+                          remaining: Optional[float], cause: str,
+                          sent: int) -> int:
+        """The in-flight recovery ladder for one captured request. Rung 1:
+        claim the durable manifest (atomic rename — two routers racing the
+        same manifest get exactly one winner) and adopt it PAUSED on a
+        sibling, whose normal resume pass promotes KV it never produced.
+        Rung 2: re-prefill from token history (recompute, never
+        zero-fill). Raises :class:`ShedError` when every rung fails; the
+        caller resolves the stream as a retryable shed."""
+        t0 = self.clock()
+        payload = claimed = None
+        if manifest is not None:
+            claimed = claim_manifest(manifest)
+            if claimed is not None:
+                try:
+                    payload = load_manifest(claimed)
+                except (ManifestError, OSError) as e:
+                    # torn or unreadable: counted + flight-recorded, then
+                    # down the ladder — the orphaned durable files age out
+                    # with the TTL sweep
+                    with self._lock:
+                        self.counters["torn_manifests"] += 1
+                    logger.warning(f"serving: manifest for donor uid="
+                                   f"{req.uid} unusable: {e}")
+                    flight_dump("torn_manifest",
+                                extra={"donor": donor.name,
+                                       "uid": req.uid, "path": claimed},
+                                key=f"torn:{claimed}")
+        cap = self.cfg.failover_attempts or len(self.replicas)
+        last: Optional[ShedError] = None
+        if payload is not None:
+            attempts = 0
+            for rep in self._ranked(exclude=(donor.name,)):
+                if attempts >= cap:
+                    break
+                attempts += 1
+                try:
+                    uid = rep.adopt(req, payload, claimed,
+                                    deadline_s=remaining,
+                                    migrated_from=donor.name,
+                                    events=events, sent=sent)
+                except ShedError as e:
+                    last = e
+                    continue
+                except Exception as e:
+                    # durable entries unusable (missing/short files): the
+                    # sibling unwound cleanly; fall to re-prefill
+                    with self._lock:
+                        self.counters["adopt_failures"] += 1
+                    logger.warning(f"serving: adopt on {rep.name} failed: "
+                                   f"{e!r}; falling back to re-prefill")
+                    payload = None
+                    break
+                new_ruid = self._record_route(rep, uid, events, ruid)
+                with self._lock:
+                    self.counters["adopts"] += 1
+                if self.metrics is not None:
+                    self.metrics.migration(cause).inc()
+                    self.metrics.migration_ms.observe(
+                        (self.clock() - t0) * 1e3)
+                return new_ruid
+        if claimed is not None:
+            # the claim is spent: a consumed-or-unusable manifest must not
+            # outlive this decision (the adopting engine owns it on the
+            # success path above)
+            try:
+                os.remove(claimed)
+            except OSError:
+                pass
+        attempts = 0
+        for rep in self._ranked(exclude=(donor.name,)):
+            if attempts >= cap:
+                break
+            attempts += 1
+            try:
+                uid = rep.adopt(req, None, None, deadline_s=remaining,
+                                migrated_from=donor.name, events=events,
+                                sent=sent)
+            except ShedError as e:
+                last = e
+                continue
+            new_ruid = self._record_route(rep, uid, events, ruid)
+            with self._lock:
+                self.counters["reprefill_failovers"] += 1
+            if self.metrics is not None:
+                self.metrics.migration(cause).inc()
+                self.metrics.reprefill_fallbacks.inc()
+                self.metrics.migration_ms.observe(
+                    (self.clock() - t0) * 1e3)
+            return new_ruid
+        raise (last if last is not None else
+               ShedError("no_replicas", retryable=True, retry_after_s=1.0,
+                         detail="no sibling adopted the migrated request"))
+
+    def rebalance_paused(self, donor: str, max_requests: int = 0) -> Dict:
+        """Voluntary rebalance: ``donor`` exports its paused batch-tier
+        work (ownership transferred to the shared tier, donor HBM/slots
+        already freed by the pause) and siblings adopt it through the
+        same ladder the crash path uses — client streams and router uids
+        intact, SSE ``migrated`` events emitted."""
+        rep = self.replicas[donor]
+        exported = rep.request_rebalance(max_requests)
+        if not exported:
+            return {"replica": donor, "exported": 0, "migrated": 0,
+                    "failed": 0}
+        items = [(req, events, PAUSED, manifest, sent)
+                 for req, manifest, events, sent in exported]
+        migrated, failed = self._migrate(rep, items, cause="rebalance")
+        with self._lock:
+            self.counters["rebalances"] += migrated
+        logger.warning(f"serving: rebalanced {migrated}/{len(exported)} "
+                       f"paused requests off {donor} "
+                       f"(failed={failed})")
+        return {"replica": donor, "exported": len(exported),
+                "migrated": migrated, "failed": failed}
 
     def _ruid_for(self, replica: str, inc: int, uid: int) -> Optional[int]:
         with self._lock:

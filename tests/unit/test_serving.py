@@ -106,7 +106,9 @@ class TestRequestManager:
         assert released == [[u_done], [u_active]]
         assert mgr.counters == {"submitted": 3, "rejected": 0, "admitted": 2,
                                 "completed": 1, "shed": 1, "expired": 1,
-                                "cancelled": 0, "paused": 0, "resumed": 0}
+                                "cancelled": 0, "paused": 0, "resumed": 0,
+                                "adopted": 0, "rebalanced": 0,
+                                "reprefills": 0}
 
     def test_shed_order_is_lowest_priority_then_newest(self):
         now = [0.0]

@@ -81,6 +81,13 @@ TRACKED = {
     # storm's aggregate decode throughput
     "bench_slo": [("resume_success_rate", "higher"),
                   ("storm_tokens_per_sec", "higher")],
+    # cross-replica migration (tools/serve_drill.py --scenario
+    # crash-migrate): every captured request must land on a sibling
+    # (durable-manifest resume or re-prefill — a failed migration sheds
+    # work the manifest promised to preserve), and the sibling's
+    # post-crash decode throughput must not crater
+    "bench_migration": [("migration_success_rate", "higher"),
+                        ("resumed_tokens_per_sec", "higher")],
 }
 
 

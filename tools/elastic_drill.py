@@ -12,6 +12,11 @@ Invariants asserted after EVERY drill:
   requests resolve as loud ``replica_crash`` sheds, never vanish);
 * **no KV-block leak** — every replica left in the pool returns its block
   pool to the fully-free state once the storm quiesces;
+* **no shared-tier leak** — the fleet's replicas share one durable NVMe
+  namespace (cross-replica migration on); at drill exit it must be EMPTY
+  (every resume manifest and durable KV file reclaimed with its
+  request), and the namespace is removed exception-safely even when an
+  assertion fails mid-drill;
 * scenario-specific checks (the crash actually produced a flight-recorder
   dump, the autoscaler actually grew and shrank the pool, the rolling
   swap actually bumped every incarnation while honoring the READY floor,
@@ -38,6 +43,7 @@ import argparse
 import glob
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
@@ -79,7 +85,15 @@ def _make_fleet(n, workdir, fleet_kw=None, serving_kw=None, cache=None):
     cache = cache or WarmStartCache(os.path.join(workdir, "warm"))
     key = warm_key(TransformerLM(get_preset("tiny")))
     engine_kw = dict(max_sequences=8, max_seq_len=128, block_size=16)
+    # every replica (initial, respawn, scale-up, swap) shares ONE durable
+    # NVMe namespace: crash-severed in-flight requests re-home onto
+    # siblings through it, and the drill asserts it is empty at exit
+    shared = os.path.join(workdir, "shared-nvme")
+    os.makedirs(shared, exist_ok=True)
     scfg = ServingConfig(**{"prefill_chunk": 32, "default_max_new_tokens": 8,
+                            "migration": {"enabled": True,
+                                          "shared_nvme_path": shared,
+                                          "manifest_ttl_s": 300.0},
                             **(serving_kw or {})})
 
     def make_replica(name):
@@ -373,6 +387,18 @@ SCENARIOS = {
 }
 
 
+def _shared_tier_leftovers(workdir) -> list:
+    """Files still alive under the fleet's shared NVMe namespace — the
+    drill-exit invariant is an EMPTY shared tier (every resume manifest
+    and durable KV file reclaimed with its request)."""
+    base = os.path.join(workdir, "shared-nvme")
+    out = []
+    for root, _dirs, files in os.walk(base):
+        out.extend(os.path.join(os.path.relpath(root, base), f)
+                   for f in files)
+    return sorted(out)
+
+
 def run_scenario(name: str, workdir=None) -> dict:
     """Run one drill; returns the verdict record (also usable from
     tests). Each scenario gets a throwaway workdir unless given one."""
@@ -381,14 +407,23 @@ def run_scenario(name: str, workdir=None) -> dict:
                          f"(have: {sorted(SCENARIOS)})")
     _fresh_injector()
     t0 = time.time()
+    owned = workdir is None
+    if owned:
+        workdir = tempfile.mkdtemp(prefix=f"elastic_{name}_")
     try:
-        if workdir is None:
-            with tempfile.TemporaryDirectory(prefix=f"elastic_{name}_") as td:
-                ok, details = SCENARIOS[name](td)
-        else:
-            ok, details = SCENARIOS[name](workdir)
+        ok, details = SCENARIOS[name](workdir)
+        leftovers = _shared_tier_leftovers(workdir)
+        details["shared_tier_leftovers"] = leftovers
+        ok = ok and not leftovers
     finally:
         _fresh_injector()
+        # exception-safe teardown (mirrors the kv-tier drill's rmtree
+        # fix): an assertion failure mid-drill must not leave the
+        # spawned replicas' shared NVMe namespace behind
+        shutil.rmtree(os.path.join(workdir, "shared-nvme"),
+                      ignore_errors=True)
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
     return {"scenario": name, "ok": ok,
             "seconds": round(time.time() - t0, 2), "details": details}
 

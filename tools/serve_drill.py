@@ -20,13 +20,17 @@ Invariants asserted after EVERY drill:
     python tools/serve_drill.py --scenario frontend-storm
     python tools/serve_drill.py --scenario prefix-storm
     python tools/serve_drill.py --scenario slo-storm
+    python tools/serve_drill.py --scenario crash-migrate
 
 Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
 A passing ``slo-storm`` run appends a ``bench_slo`` entry (preemption
 counters, resume success rate) to the perf ledger (``tools/
-bench_ledger.py``) unless ``--no-ledger``; ``tools/bench_trend.py``
-gates on it. Slow pytest wrappers live in ``tests/unit/test_serving.py``
-under the ``serving`` + ``slow`` markers (``slo`` for the SLO drill).
+bench_ledger.py``) unless ``--no-ledger``; a passing ``crash-migrate``
+run appends a ``bench_migration`` entry (migration success rate, resumed
+tokens/s); ``tools/bench_trend.py`` gates on both. Slow pytest wrappers
+live in ``tests/unit/test_serving.py`` under the ``serving`` + ``slow``
+markers (``slo`` for the SLO drill, ``migrate`` for the migration
+drill in ``tests/unit/test_migration.py``).
 """
 
 from __future__ import annotations
@@ -644,6 +648,217 @@ def scenario_slo_storm(workdir):
     return ok, details
 
 
+def scenario_crash_migrate(workdir):
+    """Two replicas share a durable NVMe namespace; one is killed
+    mid-decode with batch-tier victims paused (durable manifests on the
+    shared tier) and latency-tier work still decoding. Invariants: the
+    sibling ADOPTS >= 1 paused request through its manifest and resumes
+    it fp32-BIT-IDENTICAL to an uncrashed replay; >= 1 manifest-less
+    in-flight request recovers by re-prefill from token history
+    (recompute, never zero-fill); zero admitted uids unresolved — every
+    stream carries a ``migrated`` event and exactly one terminal record;
+    the surviving pool, its tier store, and the shared namespace
+    (manifests + KV files) are fully reclaimed."""
+    import shutil
+    import tempfile
+
+    shared = tempfile.mkdtemp(dir=workdir) if workdir \
+        else tempfile.mkdtemp()
+    try:
+        return _crash_migrate_body(shared)
+    finally:
+        # exception-safe: a failed assertion must not leak the shared
+        # namespace (same fix as the kv-tier drill's rmtree)
+        shutil.rmtree(shared, ignore_errors=True)
+
+
+def _crash_migrate_body(shared):
+    import queue as queue_mod
+
+    import numpy as np
+
+    from deepspeed_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                                 set_injector)
+    from deepspeed_tpu.serving import Replica, ReplicaRouter
+
+    pkw = {"preset_kw": {"dtype": "float32"}}
+    mig = {"enabled": True, "shared_nvme_path": shared,
+           "manifest_ttl_s": 300.0}
+    rng = np.random.default_rng(23)
+    batch_prompts = [rng.integers(0, 250, 48) for _ in range(4)]
+    lat_prompts = [rng.integers(0, 250, 24) for _ in range(3)]
+    plan = ([(p, "batch", 12) for p in batch_prompts]
+            + [(p, "latency", 8) for p in lat_prompts])
+
+    # uncrashed replay: greedy fp32 per-prompt baselines
+    solo = _make_batcher(engine_kw=pkw, default_max_new_tokens=8)
+    base = []
+    for p, _tier, n in plan:
+        uid = solo.submit(p, max_new_tokens=n)
+        solo.pump(max_steps=400)
+        base.append([int(t) for t in solo.manager.done[uid].generated])
+
+    # 17 HBM blocks is the deterministic sweet spot: four decoding batch
+    # requests hold 4 blocks each (16/17 stays under the raised
+    # watermark), and once the storm pauses two of them the three live
+    # latency requests (2 blocks each) leave only 3 free — a paused
+    # victim needs 4 to resume, so the pauses STAY paused until the
+    # crash lands
+    def mk():
+        return _make_batcher(num_blocks=17, engine_kw=pkw,
+                             default_max_new_tokens=8, max_queue_depth=32,
+                             kv_high_watermark=0.95, kv_low_watermark=0.5,
+                             slo={"enabled": True, "preempt": True},
+                             migration=mig)
+
+    r0, r1 = Replica("r0", mk()), Replica("r1", mk())
+    router = ReplicaRouter([r0, r1]).start()
+    streams, collected = [], {}
+
+    def drain_events():
+        for uid, q in streams:
+            buf = collected.setdefault(uid, [])
+            while True:
+                try:
+                    buf.append(q.get_nowait())
+                except queue_mod.Empty:
+                    break
+
+    def evs(uid, kind):
+        return [e for e in collected.get(uid, ())
+                if e.get("event") == kind]
+
+    def wait_for(cond, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            drain_events()
+            if cond():
+                return True
+            time.sleep(0.005)
+        return False
+
+    timings = {}
+    try:
+        # phase 1 — batch-tier work lands on r0 and reaches mid-decode
+        uids = []
+        for p, tier, n in plan[:len(batch_prompts)]:
+            q = queue_mod.Queue()
+            uids.append(r0.submit(p, max_new_tokens=n, tier=tier,
+                                  events=q))
+            streams.append((uids[-1], q))
+        mid_decode = wait_for(
+            lambda: all(evs(u, "token") and not evs(u, "end")
+                        for u in uids))
+
+        # phase 2 — latency storm + forced preemption: two batch victims
+        # pause, auto-exporting durable manifests onto the shared tier.
+        # The worker is FROZEN while the storm is armed — a free-running
+        # replica would burn the preempt fault on steps where the latency
+        # work is not yet admitted, pausing nobody
+        r0.paused = True
+        for p, tier, n in plan[len(batch_prompts):]:
+            q = queue_mod.Queue()
+            uids.append(r0.submit(p, max_new_tokens=n, tier=tier,
+                                  events=q))
+            streams.append((uids[-1], q))
+        set_injector(FaultInjector([{"kind": "preempt_storm", "times": 2}]))
+        r0.paused = False
+        got_paused = wait_for(lambda: r0.stats["paused_batch"] >= 1,
+                              timeout=60.0)
+        paused_at_crash = r0.stats["paused_batch"]
+
+        # phase 3 — kill r0's worker mid-decode, then fail over: PAUSED
+        # requests adopt through their manifests, severed DECODING ones
+        # re-prefill from token history on the sibling
+        set_injector(FaultInjector(
+            [FaultSpec(kind="replica_crash", site="r0")]))
+        crashed = wait_for(lambda: not r0.alive, timeout=30.0)
+        set_injector(None)
+        drain_events()
+        sent_before_crash = {u: len(evs(u, "token")) for u in uids}
+        t_crash = time.monotonic()
+        fo = router.fail_over("r0")
+        done = wait_for(lambda: all(evs(u, "end") for u in uids))
+        t_done = time.monotonic()
+        timings["crash_to_all_terminal_s"] = round(t_done - t_crash, 3)
+        quiesced = wait_for(
+            lambda: (r1.stats["active"] == 0
+                     and r1.stats["queue_depth"] == 0), timeout=30.0)
+        # shared namespace reclaimed: every manifest and durable KV file
+        # dies with its request (sibling-side discard removes files the
+        # donor produced)
+        reclaimed = wait_for(lambda: not _shared_tier_files(shared),
+                             timeout=30.0)
+        leftovers = [] if reclaimed else _shared_tier_files(shared)
+    finally:
+        _fresh_injector()
+        router.close()
+
+    drain_events()
+    ends = {u: evs(u, "end") for u in uids}
+    tokens = {u: (ends[u][0]["tokens"] if ends[u] else None)
+              for u in uids}
+    migrated_uids = [u for u in uids if evs(u, "migrated")]
+    resumed_from = {u: ends[u][0].get("migrated_from")
+                    for u in uids if ends[u]}
+    identical = all(tokens[u] == base[i] for i, u in enumerate(uids))
+    resumed_tokens = sum(len(evs(u, "token")) - sent_before_crash[u]
+                         for u in uids)
+    rc = router.counters
+    inv1 = _invariants(r1.batcher, [])
+    store = r1.batcher.engine._tier_store
+    mig_total = rc["adopts"] + rc["reprefill_failovers"]
+    rate = (mig_total / (mig_total + rc["migration_failed"])
+            if mig_total + rc["migration_failed"] else 0.0)
+    bench = {
+        "metric": "migration_success_rate", "unit": "ratio",
+        "value": rate, "migration_success_rate": rate,
+        "resumed_tokens_per_sec": round(
+            resumed_tokens / max(t_done - t_crash, 1e-9), 2),
+        "durable_adopts": rc["adopts"],
+        "reprefill_failovers": rc["reprefill_failovers"],
+    }
+    details = {
+        "mid_decode": mid_decode, "got_paused": got_paused,
+        "paused_at_crash": paused_at_crash, "crashed": crashed,
+        "failover": fo, "all_terminal": done, "quiesced": quiesced,
+        "router_counters": rc, "bench": bench, "timings": timings,
+        "migrated_uids": migrated_uids, "resumed_from": resumed_from,
+        "bit_identical_vs_uncrashed": identical,
+        "states": {u: (ends[u][0]["state"] if ends[u] else None)
+                   for u in uids},
+        "shared_tier_leftovers": leftovers,
+        "pool_r1": inv1,
+        "store_entries_r1": store.entries() if store else 0,
+    }
+    ok = (mid_decode and got_paused and paused_at_crash >= 1 and crashed
+          and done and quiesced and identical
+          and fo["failed"] == 0
+          and rc["adopts"] >= 1                 # >= 1 durable resume
+          and rc["reprefill_failovers"] >= 1    # >= 1 manifest-less
+          and all(len(ends[u]) == 1 for u in uids)
+          and all(ends[u][0]["state"] == "completed" for u in uids)
+          # every IN-FLIGHT capture resumed as an adoption from r0; a
+          # queued-at-crash capture is re-submitted fresh (no donor tag)
+          and all(f in (None, "r0") for f in resumed_from.values())
+          and sum(1 for f in resumed_from.values() if f == "r0")
+          == mig_total
+          and len(migrated_uids) == fo["migrated"]
+          and not leftovers
+          and inv1["kv_pool_restored"]
+          and (store.entries() if store else 0) == 0)
+    return ok, details
+
+
+def _shared_tier_files(shared):
+    """Every regular file still alive under the shared namespace."""
+    out = []
+    for root, _dirs, files in os.walk(shared):
+        out.extend(os.path.join(os.path.relpath(root, shared), f)
+                   for f in files)
+    return sorted(out)
+
+
 SCENARIOS = {
     "deadline-storm": scenario_deadline_storm,
     "shed-under-kv-pressure": scenario_shed_under_kv_pressure,
@@ -652,6 +867,7 @@ SCENARIOS = {
     "prefix-storm": scenario_prefix_storm,
     "kv-tier": scenario_kv_tier,
     "slo-storm": scenario_slo_storm,
+    "crash-migrate": scenario_crash_migrate,
 }
 
 
@@ -698,6 +914,14 @@ def main(argv=None) -> int:
             path = append_ledger(verdict["details"]["bench"], "bench_slo")
             print(json.dumps({"ledger": path,
                               "bench_slo": verdict["details"]["bench"]}))
+        elif name == "crash-migrate" and not args.no_ledger:
+            from bench_ledger import append_ledger
+
+            path = append_ledger(verdict["details"]["bench"],
+                                 "bench_migration")
+            print(json.dumps({"ledger": path,
+                              "bench_migration":
+                                  verdict["details"]["bench"]}))
     return rc
 
 
