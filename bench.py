@@ -262,6 +262,116 @@ def bench_zero_pp():
     return {"metric": "zero_pp_comm_reduction", **res}
 
 
+def bench_ep_sweep():
+    """The ``--ep-sweep`` mode: expert-parallel MoE decode throughput sweep
+    (expert count × world size × grouped kernel) through the packed-paged
+    serving engine. Parent re-execs onto the forced-8-virtual-device CPU
+    mesh (the ``--scaling`` trick); the child measures decode tokens/s for
+    each (E, ep, kernel) cell — ``ragged`` = ``lax.ragged_dot`` dropless
+    grouped GEMM, ``padded`` = the one-hot einsum reference — plus the
+    ragged/padded speedup and the per-expert load ``balance`` (mean/max ∈
+    (0, 1], 1.0 = perfectly even) from the AutoEP tracker, prints ONE JSON
+    line, and appends a ``bench_moe`` ledger entry that
+    ``tools/bench_trend.py`` gates."""
+    import os
+
+    if os.environ.get("DSTPU_EP_CHILD") != "1":
+        import subprocess
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": os.environ.get("XLA_FLAGS", "")
+               + " --xla_force_host_platform_device_count=8",
+               "DSTPU_EP_CHILD": "1"}
+        r = subprocess.run([sys.executable, __file__, "--ep-sweep"], env=env,
+                           timeout=3600)
+        return r.returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.config.config import ServingConfig
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, get_preset
+    from deepspeed_tpu.observability.registry import MetricsRegistry
+    from deepspeed_tpu.serving import ContinuousBatcher
+
+    # FFN wide enough that the padded reference's E-fold redundant FLOPs
+    # dominate dispatch overhead, and a decode batch deep enough that the
+    # grouped GEMM sees real row counts — the regime the dropless kernel
+    # targets (a 8-seq batch at top_k=2 is only 16 rows/call)
+    n_req, n_new, ffn, n_seq = 32, 32, 1024, 32
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 250, 24).tolist() for _ in range(n_req)]
+    res = {"metric": "moe_decode_tokens_per_sec", "moe": {},
+           "config": {"preset": "tiny", "top_k": 2, "requests": n_req,
+                      "new_tokens": n_new, "intermediate_size": ffn,
+                      "world": len(jax.devices())}}
+
+    def one_pass(b):
+        uids = [b.submit(p) for p in prompts]
+        t0 = time.perf_counter()
+        b.pump(max_steps=1200)
+        dt = time.perf_counter() - t0
+        toks = sum(len(b.manager.done[u].generated) for u in uids
+                   if u in b.manager.done)
+        for u in uids:
+            b.manager.resolve(u)
+        return toks, dt
+
+    def run_cell(E, ep):
+        # build BOTH kernels' engines up front and interleave the timed
+        # passes (R,P,R,P,...) so slow machine-load drift cancels out of
+        # the ragged/padded ratio instead of landing on whichever kernel
+        # happened to run second
+        bs, best = {}, {}
+        for kernel in ("ragged", "padded"):
+            eng = InferenceEngineV2(
+                TransformerLM(get_preset("tiny", num_experts=E, top_k=2,
+                                         intermediate_size=ffn,
+                                         moe_dispatch="grouped")),
+                max_sequences=n_seq, max_seq_len=128, block_size=16,
+                num_blocks=8 * n_seq,
+                mesh={"ep": ep, "dp": len(jax.devices()) // ep} if ep > 1
+                else None,
+                moe_kernel=kernel)
+            reg = MetricsRegistry()
+            eng.enable_metrics(registry=reg)
+            bs[kernel] = ContinuousBatcher(eng, ServingConfig(
+                prefill_chunk=32, default_max_new_tokens=n_new))
+            one_pass(bs[kernel])  # compile warmup
+        for _ in range(3):  # best-of-3, interleaved
+            for kernel, b in bs.items():
+                toks, dt = one_pass(b)
+                if toks / dt > best.get(kernel, (0.0, 0.0))[0]:
+                    best[kernel] = (toks / dt, dt)
+        cells = {}
+        for kernel, b in bs.items():
+            eng = b.engine
+            counts = eng._moe_tracker.snapshot() \
+                if eng._moe_tracker is not None else None
+            bal = (float(counts.mean() / counts.max())
+                   if counts is not None and counts.max() > 0 else 1.0)
+            cells[kernel] = {"tokens_per_sec": round(best[kernel][0], 2),
+                             "decode_s": round(best[kernel][1], 4),
+                             "kernel": eng.moe_kernel,
+                             "balance": round(bal, 4)}
+        return cells
+
+    for E in (4, 8):
+        for ep in (1, E):  # ep must divide the expert count
+            cells = run_cell(E, ep)
+            cells["ragged"]["ragged_speedup"] = round(
+                cells["ragged"]["tokens_per_sec"]
+                / max(cells["padded"]["tokens_per_sec"], 1e-9), 3)
+            for k, cell in cells.items():
+                res["moe"][f"E{E}-ep{ep}-{k}"] = cell
+
+    print(json.dumps(res))
+    _ledger(res, "bench_moe")
+    return 0
+
+
 def _ledger(result, bench):
     """Append to the perf-trend ledger (tools/bench_ledger.jsonl) —
     best-effort; the ledger must never sink the headline."""
@@ -368,6 +478,8 @@ def _latest_capacity_artifact():
 if __name__ == "__main__":
     if "--scaling" in sys.argv:
         sys.exit(bench_scaling())
+    elif "--ep-sweep" in sys.argv:
+        sys.exit(bench_ep_sweep())
     elif "--zero-pp" in sys.argv:
         import json as _json
 

@@ -46,7 +46,8 @@ from deepspeed_tpu.ops.quantization import (dequantize_blockwise,
                                             quantize_blockwise)
 
 __all__ = [
-    "all_gather_q", "reduce_scatter_q", "broadcast_q",
+    "all_gather_q", "reduce_scatter_q", "broadcast_q", "all_to_all_q",
+    "all_to_all_dense", "moe_all_to_all", "moe_a2a_wire_bytes",
     "two_hop_reduce_scatter", "two_hop_all_gather",
     "intra_groups", "cross_groups", "effective_group_size", "wire_bytes",
     "effective_bits", "quant_roundtrip_error",
@@ -297,3 +298,102 @@ def two_hop_all_gather(x: jax.Array, axis, slice_size: int, bits: int = 8,
     g = lax.all_gather(chunk, axis, axis=gather_dim, tiled=True,
                        axis_index_groups=intra_groups(world, s))
     return _slice_merge(g, gather_dim, s, m)
+
+
+# ---------------------------------------------------------------------------
+# all-to-all (the MoE expert-dispatch wire — serving-side qgZ)
+# ---------------------------------------------------------------------------
+
+def all_to_all_dense(x: jax.Array, axis,
+                     axis_index_groups: Optional[Sequence] = None,
+                     op: str = "all_to_all") -> jax.Array:
+    """Logged dense all-to-all: ``x`` is ``[world, ...]`` with one chunk
+    per destination peer; the result holds chunk ``j`` FROM peer ``j``."""
+    _log(op, x)
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False,
+                          axis_index_groups=axis_index_groups)
+
+
+def all_to_all_q(x: jax.Array, axis, bits: int = 8, block_size: int = 2048,
+                 axis_index_groups: Optional[Sequence] = None,
+                 out_dtype=None, op: str = "all_to_all") -> jax.Array:
+    """Quantized all-to-all: each per-destination chunk ``x[i]`` is
+    blockwise-quantized, payload + scales ride one all-to-all each, and
+    arrival dequantizes back to ``x.dtype`` — the serving-side analog of
+    :func:`reduce_scatter_q` without the local reduction (MoE token
+    dispatch keeps every chunk distinct)."""
+    dtype = out_dtype or x.dtype
+    b = effective_bits(x[0].size, bits, block_size)
+    q, scale = jax.vmap(
+        lambda c: quantize_blockwise(c, bits=b,
+                                     group_size=block_size))(x)
+    _log(op, x, nbytes=q.size * q.dtype.itemsize
+         + scale.size * scale.dtype.itemsize)
+    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False,
+                        axis_index_groups=axis_index_groups)
+    st = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
+                        tiled=False, axis_index_groups=axis_index_groups)
+    return jax.vmap(lambda qq, ss: dequantize_blockwise(
+        qq, ss, bits=b, shape=x.shape[1:], dtype=dtype))(qt, st)
+
+
+def moe_all_to_all(x: jax.Array, axis, bits: int = 0,
+                   block_size: int = 2048, slice_size: int = 0) -> jax.Array:
+    """The MoE expert-dispatch all-to-all: ``x`` is ``[ep, cap, ...]``
+    (one capacity-padded chunk per destination shard). ``bits=0`` moves
+    the chunks dense in the input dtype; ``bits`` 8/4 quantizes them
+    blockwise on the wire (combine weights re-scale on return, so the
+    error budget matches one qgZ hop).
+
+    ``slice_size`` ``s`` with ``1 < s < world`` selects the hierarchical
+    two-hop form (the PR 14 qgZ split applied to inference): chunks cross
+    slices FIRST — one (quantized when ``bits``>0) all-to-all between
+    same-position peers over DCN — then each slice redistributes to the
+    final member dense over ICI, logged ``all_to_all_intra``. Tokens are
+    int8 across DCN and bf16 inside a slice; quantization error enters
+    once, on the slow hop."""
+    world = lax.axis_size(axis)
+    s = int(slice_size)
+    if s <= 1 or s >= world:
+        if bits:
+            return all_to_all_q(x, axis, bits=bits, block_size=block_size)
+        return all_to_all_dense(x, axis)
+    m = world // s
+    tail = x.shape[1:]
+    x2 = x.reshape((m, s) + tail)      # one [s, ...] chunk per dest slice
+    if bits:
+        r1 = all_to_all_q(x2, axis, bits=bits, block_size=block_size,
+                          axis_index_groups=cross_groups(world, s))
+    else:
+        r1 = all_to_all_dense(x2, axis,
+                              axis_index_groups=cross_groups(world, s))
+    # r1[i, j] = chunk from (slice i, my member index) bound for member j
+    # of MY slice — swap to member-major so the intra hop delivers it
+    t = jnp.swapaxes(r1, 0, 1)         # [s, m, ...]
+    _log("all_to_all_intra", t)
+    o2 = lax.all_to_all(t, axis, split_axis=0, concat_axis=0, tiled=False,
+                        axis_index_groups=intra_groups(world, s))
+    # o2[j, i] = chunk whose SOURCE is device i*s + j — un-permute to the
+    # natural source order the single-hop form produces
+    return jnp.swapaxes(o2, 0, 1).reshape((world,) + tail)
+
+
+def moe_a2a_wire_bytes(ep: int, chunk_elems: int, bits: int = 0,
+                       block_size: int = 2048, slice_size: int = 0,
+                       itemsize: int = 2):
+    """Analytic per-shard wire payload of ONE :func:`moe_all_to_all` call,
+    keyed by the op counter it lands in (``comm_drill --scenario moe-a2a``
+    asserts the trace-logged deltas equal this exactly).
+    ``chunk_elems`` is the element count of one destination chunk."""
+    s = int(slice_size)
+    out = {"all_to_all": 0, "all_to_all_intra": 0}
+    if s <= 1 or s >= ep:
+        out["all_to_all"] = (ep * wire_bytes(chunk_elems, bits, block_size)
+                             if bits else ep * chunk_elems * itemsize)
+        return out
+    m = ep // s
+    slice_chunk = s * chunk_elems
+    out["all_to_all"] = (m * wire_bytes(slice_chunk, bits, block_size)
+                         if bits else m * slice_chunk * itemsize)
+    out["all_to_all_intra"] = ep * chunk_elems * itemsize
+    return out

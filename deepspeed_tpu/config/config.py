@@ -432,6 +432,31 @@ class MoEConfig(DSTpuConfigModel):
     drop_tokens: bool = True
     use_rts: bool = True  # random token selection
     noisy_gate_policy: Optional[str] = None  # None|Jitter|RSample
+    # grouped-dispatch expert FFN kernel: "ragged" = lax.ragged_dot grouped
+    # GEMM (falls back to "padded" with one logged warning where it cannot
+    # lower), "padded" = force the capacity-einsum reference twin
+    kernel: str = "ragged"
+    # a2a dispatch wire format (comm/quantized.py): 0 = dense activations,
+    # 4/8 = blockwise-quantized payload; a2a_slice > 1 selects the two-hop
+    # hierarchical a2a (quantized across DCN, dense inside a slice)
+    a2a_bits: int = 0
+    a2a_slice: int = 0
+    # spare physical expert slots per ep shard for AutoEP hot-expert
+    # replication (moe/balancer.py); 0 = one slot per expert, no headroom
+    replica_slots: int = 0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.kernel not in ("ragged", "padded"):
+            raise ValueError("moe.kernel must be 'ragged' or 'padded', "
+                             f"got {self.kernel!r}")
+        if self.a2a_bits not in (0, 4, 8):
+            raise ValueError("moe.a2a_bits must be 0, 4 or 8, got "
+                             f"{self.a2a_bits}")
+        if self.a2a_slice < 0 or self.replica_slots < 0:
+            raise ValueError("moe.a2a_slice and moe.replica_slots must "
+                             "be >= 0")
+        return self
 
 
 class PipelineConfig(DSTpuConfigModel):

@@ -71,7 +71,11 @@ class InferenceEngineV2:
                  packed: bool = True, topology=None,
                  mesh: Optional[dict] = None, kv_dtype: str = "bf16",
                  weight_dtype: str = "bf16", prefix_cache=None,
-                 speculative=None, decode_kernel: str = "pallas"):
+                 speculative=None, decode_kernel: str = "pallas",
+                 moe_kernel: Optional[str] = None,
+                 moe_a2a_bits: Optional[int] = None,
+                 moe_a2a_slice: Optional[int] = None,
+                 moe_replica_slots: int = 0):
         import functools
 
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -181,6 +185,41 @@ class InferenceEngineV2:
         else:
             self.decode_kernel_mode = "xla"
         self.decode_kernel = decode_kernel
+        # ---- MoE expert-parallel serving (moe.kernel / a2a wire / AutoEP).
+        # Mirrors the decode-kernel selection above: the grouped-GEMM
+        # kernel is resolved ONCE (probe + one logged fallback warning) and
+        # baked into the step jits via the model's moe_fn seam; the a2a
+        # wire format rides the same partial. Placement state starts at the
+        # natural layout and is rewritten by rebalance_moe().
+        self.moe_kernel = None
+        self.moe_kernel_reason = ""
+        self._moe_ep = False
+        self._moe_assign = None
+        self._moe_slots = 0
+        self._moe_tracker = None
+        if getattr(self.cfg, "num_experts", 1) > 1 and \
+                getattr(self.cfg, "moe_dispatch", "capacity") == "grouped":
+            from deepspeed_tpu.moe import sharded_moe as _moe
+
+            want = moe_kernel if moe_kernel is not None else \
+                getattr(self.cfg, "moe_kernel", "ragged")
+            self.moe_kernel, self.moe_kernel_reason = \
+                _moe.resolve_moe_kernel(want)
+            self._moe_a2a_bits = int(
+                moe_a2a_bits if moe_a2a_bits is not None
+                else getattr(self.cfg, "moe_a2a_bits", 0) or 0)
+            self._moe_a2a_slice = int(
+                moe_a2a_slice if moe_a2a_slice is not None
+                else getattr(self.cfg, "moe_a2a_slice", 0) or 0)
+            # baked into every step jit below through the moe_fn attribute
+            # (tracing is lazy, so this must land before the first dispatch)
+            model.moe_fn = functools.partial(
+                _moe.grouped_moe_mlp_block, kernel=self.moe_kernel,
+                a2a_bits=self._moe_a2a_bits, a2a_slice=self._moe_a2a_slice)
+            self._moe_ep = ("ep" in self.mesh.axis_names
+                            and self.mesh.shape["ep"] > 1)
+            if self._moe_ep and moe_replica_slots > 0:
+                self._moe_expand_placement(moe_replica_slots)
         if paged:
             self.num_blocks = self.state.allocator.num_blocks
             cache = model.init_paged_kv_cache(
@@ -403,6 +442,112 @@ class InferenceEngineV2:
                 "inference/decode_prologue_promotes",
                 "tier promotions folded into a fused step prologue"),
         }
+        if getattr(self.cfg, "num_experts", 1) > 1:
+            from deepspeed_tpu.moe import balancer as _bal
+            from deepspeed_tpu.moe import sharded_moe as _moe
+
+            self._moe_tracker = _bal.ExpertLoadTracker(
+                self.cfg.num_experts, registry=r)
+            _moe.set_expert_tracker(self._moe_tracker)
+            self._obs["moe_rebalances"] = r.counter(
+                "moe/rebalances", "applied expert placement rebalances")
+
+    # ---- AutoEP expert placement (moe/balancer.py) -----------------------
+    def _moe_place(self, mlp, assign, prev_assign):
+        """Gather the layer-stacked expert leaves into physical slot order
+        (expert axis 1 — axis 0 is the layer scan) and attach the routing
+        tables broadcast over layers, re-pinned to each leaf's sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.moe import balancer as _bal
+
+        E = self.cfg.num_experts
+        ep = self.mesh.shape["ep"]
+        new = _bal.apply_placement(
+            {n: v for n, v in mlp.items()
+             if n not in ("place_dest", "place_slot", "place_nrep")},
+            assign, E, ep, prev_assign=prev_assign, expert_axis=1)
+        L = self.cfg.num_layers
+        out = {}
+        for name, leaf in new.items():
+            if name in ("place_dest", "place_slot", "place_nrep"):
+                # tables ride the layer scan like every other leaf: L
+                # identical copies (int32, KBs), replicated over the mesh
+                t = jnp.broadcast_to(leaf[None], (L,) + leaf.shape)
+                out[name] = jax.device_put(
+                    jnp.asarray(t), NamedSharding(
+                        self.mesh, P(*([None] * (leaf.ndim + 1)))))
+            elif name in mlp and hasattr(mlp[name], "sharding"):
+                out[name] = jax.device_put(leaf, mlp[name].sharding)
+            else:
+                out[name] = leaf
+        return out
+
+    def _moe_expand_placement(self, replica_slots: int) -> None:
+        """Grow the expert grid to ``ceil(E/ep) + replica_slots`` physical
+        slots per shard at the natural (round-robin) assignment — the spare
+        slots start as extra replicas so the FIRST rebalance is a pure
+        re-placement, never a retrace (table shapes are static in R)."""
+        E = self.cfg.num_experts
+        ep = self.mesh.shape["ep"]
+        slots = -(-E // ep) + int(replica_slots)
+        assign = [i % E for i in range(ep * slots)]
+        mlp = self.params["layers"]["mlp"]
+        placed = self._moe_place(
+            {n: v for n, v in mlp.items() if n != "router"}, assign, None)
+        placed["router"] = mlp["router"]
+        self.params["layers"]["mlp"] = placed
+        # the served tree no longer matches the init-time spec tree (the
+        # expert axis grew and table leaves appeared) — same rule as the
+        # quantizer restructuring above
+        self.param_sharding = None
+        self._moe_assign = assign
+        self._moe_slots = slots
+
+    def rebalance_moe(self, counts=None, min_gain: float = 0.0):
+        """Re-place (and re-replicate) experts from observed load — the
+        AutoEP control step. Safe at any step boundary: the swap happens
+        between dispatches, replicas are exact weight copies, and every
+        routed pair still reaches its expert, so greedy outputs are
+        bit-identical across the event (asserted by the moe-storm drill).
+
+        ``counts`` defaults to the metrics tracker's current window
+        (``enable_metrics`` must be on in that case); the tracker window
+        resets after planning so the next decision sees fresh traffic.
+        Returns the applied :class:`~deepspeed_tpu.moe.balancer.
+        RebalancePlan`, or ``None`` when below ``min_gain`` or not serving
+        expert-parallel MoE.
+        """
+        from deepspeed_tpu.moe import balancer as _bal
+
+        if not self._moe_ep or self._moe_assign is None:
+            return None
+        if counts is None:
+            if self._moe_tracker is None:
+                raise ValueError("rebalance_moe() needs counts= or "
+                                 "enable_metrics() for the load tracker")
+            counts = self._moe_tracker.snapshot()
+            self._moe_tracker.reset()
+        ep = self.mesh.shape["ep"]
+        plan = _bal.plan_rebalance(counts, ep, self._moe_slots,
+                                   prev_assign=self._moe_assign)
+        if plan.moved_slots == 0 or \
+                plan.imbalance_before - plan.imbalance_after <= min_gain:
+            return None
+        mlp = self.params["layers"]["mlp"]
+        placed = self._moe_place(
+            {n: v for n, v in mlp.items() if n != "router"},
+            plan.assign, self._moe_assign)
+        placed["router"] = mlp["router"]
+        self.params["layers"]["mlp"] = placed
+        self._moe_assign = plan.assign
+        if self._obs is not None and "moe_rebalances" in self._obs:
+            self._obs["moe_rebalances"].inc()
+        log_dist(f"moe rebalance: imbalance "
+                 f"{plan.imbalance_before:.2f} -> {plan.imbalance_after:.2f} "
+                 f"(bound {plan.bound:.2f}), {plan.moved_slots} slots moved, "
+                 f"nrep={plan.nrep}")
+        return plan
 
     # ---- scheduling surface (engine_v2.py:184 parity) --------------------
     def query(self, uid: int, n_tokens: int) -> bool:
@@ -1293,6 +1438,14 @@ class InferenceEngineV2:
                                              [steps] * len(batch_uids)):
             raise CapacityError(batch_uids, [steps] * len(batch_uids),
                                 "decode_batch")
+        if self._moe_ep:
+            from deepspeed_tpu.resilience.faults import get_injector
+
+            inj = get_injector()
+            if inj:
+                # fires BEFORE any sequence state mutates: an injected a2a
+                # failure unwinds to the batcher as a cleanly failed step
+                inj.on_moe_dispatch("decode")
         descs = [self.state.schedule(uid, steps) for uid in batch_uids]
         B = len(descs)
         bpad = max(8, 1 << (B - 1).bit_length())  # bounded jit cache as B drains
@@ -1709,6 +1862,15 @@ class InferenceEngineV2:
         t_put = time.perf_counter()
         self.timing = {}        # never report a previous put's numbers
         chunks = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if self._moe_ep:
+            from deepspeed_tpu.resilience.faults import get_injector
+
+            inj = get_injector()
+            if inj:
+                # before any sequence/prefix state mutates (see decode site)
+                inj.on_moe_dispatch(
+                    "prefill" if any(len(c) > 1 for c in chunks)
+                    else "decode")
         if self.prefix_cache is not None \
                 and any(len(c) > 1 and u not in self.state.sequences
                         for u, c in zip(batch_uids, chunks)) \

@@ -98,6 +98,15 @@ class TransformerConfig:
     # (cap = S_local*top_k); f>0 → cap ≈ S_local*top_k*f/ep (may drop
     # overflow pairs under extreme router imbalance)
     moe_ep_capacity_factor: float = 0.0
+    # grouped-dispatch FFN kernel: "ragged" (lax.ragged_dot grouped GEMM,
+    # auto-fallback) | "padded" (capacity-einsum reference twin)
+    moe_kernel: str = "ragged"
+    # a2a dispatch wire (comm/quantized.py): 0 = dense, 4/8 = blockwise
+    # quantized payload; moe_a2a_slice > 1 = hierarchical two-hop a2a
+    # (quantized across DCN, dense inside a slice of that many shards)
+    moe_a2a_bits: int = 0
+    moe_a2a_slice: int = 0
+    moe_a2a_block: int = 512
 
     def __post_init__(self):
         is_llama = self.arch == "llama"

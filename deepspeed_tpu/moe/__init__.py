@@ -7,7 +7,12 @@ the EP group algebra of ``utils/groups.py:304``. On TPU the expert dimension is 
 constraints, so XLA SPMD emits the same all-to-alls the reference issues manually.
 """
 
+from deepspeed_tpu.moe.balancer import (  # noqa: F401
+    ExpertLoadTracker, RebalancePlan, apply_placement, placement_tables,
+    plan_rebalance,
+)
 from deepspeed_tpu.moe.sharded_moe import (  # noqa: F401
-    MoE, grouped_moe_mlp_block, moe_block_for, moe_mlp_block, top1_gating,
+    MOE_KERNELS, MoE, grouped_moe_mlp_block, moe_block_for, moe_kernel_support,
+    moe_mlp_block, resolve_moe_kernel, set_expert_tracker, top1_gating,
     topk_gating,
 )

@@ -14,6 +14,8 @@ hot path; dropped tokens pass through the residual, exactly like the reference w
 
 from __future__ import annotations
 
+import functools
+import logging
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -22,12 +24,96 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.sharding import constrain
+from deepspeed_tpu.utils.logging import log_dist
+
+#: placement-table leaves (moe/balancer.py) that ride the expert weight
+#: dict replicated — everything else under the dict is an expert stack
+PLACEMENT_LEAVES = ("place_dest", "place_slot", "place_nrep")
 
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int) -> int:
     cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
     return max(cap, min_capacity)
+
+
+# ---------------------------------------------------------------------------
+# grouped-GEMM kernel selection (the PR 18 decode_kernel pattern)
+# ---------------------------------------------------------------------------
+
+MOE_KERNELS = ("ragged", "padded")
+_SUPPORT_MEMO: Optional[Tuple[Optional[str], str]] = None
+_FALLBACK_WARNED = False
+
+
+def moe_kernel_support() -> Tuple[Optional[str], str]:
+    """How the dropless grouped expert GEMM can run on this backend:
+    ``("native", why)`` when ``jax.lax.ragged_dot`` lowers here, ``(None,
+    why)`` otherwise — callers log ``why`` once and fall back to
+    ``moe.kernel: padded`` (the capacity-einsum reference)."""
+    global _SUPPORT_MEMO
+    if _SUPPORT_MEMO is not None:
+        return _SUPPORT_MEMO
+    if not hasattr(jax.lax, "ragged_dot"):
+        _SUPPORT_MEMO = (None, "this jax has no lax.ragged_dot")
+        return _SUPPORT_MEMO
+    try:
+        jax.jit(jax.lax.ragged_dot).lower(
+            jax.ShapeDtypeStruct((4, 2), jnp.float32),
+            jax.ShapeDtypeStruct((2, 2, 3), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.int32)).compile()
+    except Exception as e:                     # no backend lowering
+        _SUPPORT_MEMO = (None, f"ragged_dot probe failed: {e!r}")
+        return _SUPPORT_MEMO
+    _SUPPORT_MEMO = ("native", "lax.ragged_dot grouped GEMM compiles here")
+    return _SUPPORT_MEMO
+
+
+def resolve_moe_kernel(kernel: str) -> Tuple[str, str]:
+    """Resolve a configured ``moe.kernel`` against backend support:
+    ``ragged`` degrades to ``padded`` with ONE logged warning when the
+    grouped GEMM cannot lower (never silently — the reason is returned
+    for the engine to surface). Returns ``(kernel, fallback_reason)``."""
+    global _FALLBACK_WARNED
+    if kernel not in MOE_KERNELS:
+        raise ValueError(f"moe kernel must be one of {MOE_KERNELS}, "
+                         f"got {kernel!r}")
+    if kernel == "padded":
+        return "padded", ""
+    mode, reason = moe_kernel_support()
+    if mode is None:
+        if not _FALLBACK_WARNED:
+            log_dist(f"moe.kernel: ragged grouped GEMM unavailable "
+                     f"({reason}); falling back to the padded capacity "
+                     f"einsum", level=logging.WARNING)
+            _FALLBACK_WARNED = True
+        return "padded", reason
+    return "ragged", ""
+
+
+# ---------------------------------------------------------------------------
+# expert-load observation (AutoEP input — moe/balancer.py)
+# ---------------------------------------------------------------------------
+
+_TRACKER = None
+
+
+def set_expert_tracker(tracker) -> None:
+    """Install (or clear, with ``None``) the process-wide expert-load
+    tracker. Checked at TRACE time: install it before the first jitted
+    dispatch or the counts callback is not baked into the program.
+    ``None`` (the default) costs nothing in the hot path."""
+    global _TRACKER
+    _TRACKER = tracker
+
+
+def _emit_expert_counts(counts) -> None:
+    """``jax.debug.callback`` body: forward one dispatch's per-expert
+    routed-token counts (a partial sum under ep — shards' contributions
+    add up to the global count) to the installed tracker."""
+    t = _TRACKER
+    if t is not None:
+        t.observe(counts)
 
 
 def _route(logits: jax.Array, k: int, rng: Optional[jax.Array] = None,
@@ -147,10 +233,13 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     E = w["router"].shape[-1]
     x = h.reshape(B * T, D)
     logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)
-    dispatch, combine, aux, _ = topk_gating(
+    dispatch, combine, aux, stats = topk_gating(
         logits, k=cfg.top_k, capacity_factor=cfg.capacity_factor,
         min_capacity=getattr(cfg, "min_capacity", 4),
         valid=None if valid is None else valid.reshape(-1))
+    if _TRACKER is not None:
+        jax.debug.callback(_emit_expert_counts,
+                           stats["tokens_per_expert"].astype(jnp.int32))
 
     dt = h.dtype
     xe = jnp.einsum("sec,sd->ecd", dispatch.astype(dt), x)       # [E, C, D]
@@ -172,12 +261,45 @@ def moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     return y.reshape(B, T, D), aux
 
 
+def _padded_ffn(xs: jax.Array, group_sizes: jax.Array,
+                w: Dict[str, jax.Array], dt) -> jax.Array:
+    """The pad-to-capacity einsum reference at ``capacity_factor=∞``:
+    every expert padded to the FULL token count and computed with the
+    same einsum chain as the capacity path. O(N·E) flops and an
+    ``[E, N, D]`` intermediate vs the grouped path's O(N) — this is the
+    baseline the ragged kernel is measured against (``moe.kernel:
+    padded``, and the automatic fallback when ``ragged_dot`` cannot
+    lower). Still dropless: padding rows carry zero and drop nothing."""
+    N = xs.shape[0]
+    E = group_sizes.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    seg = jnp.sum(jnp.arange(N)[:, None] >= ends[None, :], axis=-1)
+    oh = jax.nn.one_hot(seg, E, dtype=dt)                 # [N, E]
+    xe = jnp.einsum("ne,nd->end", oh, xs)                 # [E, N, D]
+    if _has_gate(w):
+        act = jax.nn.silu(jnp.einsum("end,edf->enf", xe,
+                                     _expert_weight(w, "w_gate", dt)))
+        act = act * jnp.einsum("end,edf->enf", xe,
+                               _expert_weight(w, "w_up", dt))
+    else:
+        act = jax.nn.gelu(jnp.einsum("end,edf->enf", xe,
+                                     _expert_weight(w, "w_up", dt)),
+                          approximate=True)
+    ye = jnp.einsum("enf,efd->end", act,
+                    _expert_weight(w, "w_down", dt))      # [E, N, D]
+    return jnp.einsum("ne,end->nd", oh, ye)
+
+
 def _grouped_ffn(xs: jax.Array, group_sizes: jax.Array, w: Dict[str, jax.Array],
-                 dt) -> jax.Array:
-    """Expert-grouped FFN over tokens sorted by expert: the
-    ``lax.ragged_dot`` chain XLA lowers to a grouped (MegaBlocks-style) GEMM
-    (int8 serving stacks dequant inside the operand read, see
-    :func:`_expert_weight`)."""
+                 dt, kernel: str = "ragged") -> jax.Array:
+    """Expert-grouped FFN over tokens sorted by expert. ``kernel="ragged"``
+    is the ``lax.ragged_dot`` chain XLA lowers to a grouped
+    (MegaBlocks-style) GEMM (int8 serving stacks dequant inside the
+    operand read, see :func:`_expert_weight`); ``"padded"`` is the
+    capacity-einsum reference twin (:func:`_padded_ffn`) the engines fall
+    back to when ragged_dot has no backend lowering."""
+    if kernel == "padded":
+        return _padded_ffn(xs, group_sizes, w, dt)
     if _has_gate(w):
         act = jax.nn.silu(jax.lax.ragged_dot(
             xs, _expert_weight(w, "w_gate", dt), group_sizes))
@@ -192,26 +314,35 @@ def _grouped_ffn(xs: jax.Array, group_sizes: jax.Array, w: Dict[str, jax.Array],
 
 
 def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
-                          valid: Optional[jax.Array] = None
+                          valid: Optional[jax.Array] = None, *,
+                          kernel: Optional[str] = None,
+                          a2a_bits: Optional[int] = None,
+                          a2a_slice: Optional[int] = None
                           ) -> Tuple[jax.Array, jax.Array]:
     """Dropless sort-based dispatch over grouped GEMMs — the
     ``inference/v2/kernels/cutlass_ops/moe_gemm`` (MegaBlocks-style) analog,
-    expressed with ``jax.lax.ragged_dot`` so XLA emits the grouped matmul.
+    expressed with ``jax.lax.ragged_dot`` so XLA emits the grouped matmul
+    (``kernel="padded"`` swaps in the capacity-einsum reference twin; the
+    default resolves ``cfg.moe_kernel`` with automatic fallback).
 
     Unlike the capacity path, every (token, expert) pair is computed — no
     ``capacity_factor`` padding waste and no dropped tokens — at the price of
     data-dependent group sizes (static TOTAL shape ``S*k``, so it still jits).
     Under ``ep > 1`` dispatch routes through ``_grouped_moe_ep`` — an explicit
     padded all-to-all over the ``ep`` axis feeding per-shard grouped GEMMs (the
-    ``_AllToAll`` of reference ``moe/sharded_moe.py:97``, made dropless).
-    ``valid`` [B, T] masks padding/idle decode lanes out of the aux stats and
-    combine weights.
+    ``_AllToAll`` of reference ``moe/sharded_moe.py:97``, made dropless) —
+    with ``a2a_bits``/``a2a_slice`` selecting the quantized / two-hop wire
+    format (``comm/quantized.py``). ``valid`` [B, T] masks padding/idle decode
+    lanes out of the aux stats and combine weights.
     """
+    if kernel is None:
+        kernel, _ = resolve_moe_kernel(getattr(cfg, "moe_kernel", "ragged"))
     mesh = jax.sharding.get_abstract_mesh()
     if (mesh is not None and not mesh.empty and "ep" in mesh.axis_names
             and mesh.shape["ep"] > 1
             and "ep" not in set(getattr(mesh, "manual_axes", ()) or ())):
-        return _grouped_moe_ep(h, w, cfg, mesh, valid)
+        return _grouped_moe_ep(h, w, cfg, mesh, valid, kernel=kernel,
+                               a2a_bits=a2a_bits, a2a_slice=a2a_slice)
     B, T, D = h.shape
     E = w["router"].shape[-1]
     k = cfg.top_k
@@ -225,17 +356,26 @@ def grouped_moe_mlp_block(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     order = jnp.argsort(flat_expert)                          # group by expert
     tok = order // k
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+    if _TRACKER is not None:
+        real = (jnp.ones((S,), bool) if valid is None
+                else valid.reshape(-1))
+        cnt = jnp.sum(jax.nn.one_hot(flat_expert, E, dtype=jnp.int32)
+                      * jnp.repeat(real, k)[:, None].astype(jnp.int32),
+                      axis=0)
+        jax.debug.callback(_emit_expert_counts, cnt)
 
     dt = h.dtype
     xs = x[tok].astype(dt)                                    # [S*k, D]
-    ys = _grouped_ffn(xs, group_sizes, w, dt)                 # [S*k, D]
+    ys = _grouped_ffn(xs, group_sizes, w, dt, kernel)         # [S*k, D]
     weights = topk_vals.reshape(-1)[order].astype(dt)
     out = jnp.zeros((S, D), dt).at[tok].add(ys * weights[:, None])
     return out.reshape(B, T, D), aux_loss
 
 
 def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
-                    mesh, valid: Optional[jax.Array] = None
+                    mesh, valid: Optional[jax.Array] = None,
+                    kernel: str = "ragged", a2a_bits: Optional[int] = None,
+                    a2a_slice: Optional[int] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Expert-parallel dropless dispatch: tokens resharded over ``ep``, routed
     through a capacity-padded ``all_to_all`` to the shard owning each expert,
@@ -247,26 +387,50 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     routed (token, expert) pair is computed exactly, so an imported Mixtral
     keeps its released routing function under ``ep > 1``.
 
-    Shapes are static: the a2a payload is ``[ep, cap, D+2]`` per shard (the
-    two extra lanes carry the routed expert id, so the id exchange rides the
-    same collective), with ``cap = S_local * top_k`` by default (worst-case
-    dropless — total payload equals the single-shard dispatch size).
-    ``cfg.moe_ep_capacity_factor > 0`` shrinks ``cap`` toward the
-    balanced-load size ``S_local*k/ep`` at the cost of dropping overflow
-    pairs under extreme imbalance (documented trade, like the reference's
-    ``capacity_factor``). Token count is padded up to a multiple of ``ep``
-    (pad rows route with zero combine weight and are masked out of the aux
-    stats), so B=1 single-request decode works on any ep mesh.
+    Shapes are static: the a2a moves ``[ep, cap, D]`` activations plus an
+    ``[ep, cap]`` int32 slot-id exchange per shard (ids ride their own
+    dense a2a so wire quantization can never corrupt routing), with ``cap
+    = S_local * top_k`` by default (worst-case dropless — total payload
+    equals the single-shard dispatch size). ``cfg.moe_ep_capacity_factor
+    > 0`` shrinks ``cap`` toward the balanced-load size ``S_local*k/ep``
+    at the cost of dropping overflow pairs under extreme imbalance
+    (documented trade, like the reference's ``capacity_factor``). Token
+    count is padded up to a multiple of ``ep`` (pad rows route with zero
+    combine weight and are masked out of the aux stats), so B=1
+    single-request decode works on any ep mesh.
+
+    The wire format follows ``comm/quantized.py``: ``a2a_bits`` (default
+    ``cfg.moe_a2a_bits``, 0 = dense bf16) quantizes the activation
+    payload blockwise; ``a2a_slice`` (default ``cfg.moe_a2a_slice``)
+    selects the hierarchical two-hop a2a — int8 across DCN, bf16 inside
+    a slice — and everything flows through the comm byte accounting
+    (``comm_drill --scenario moe-a2a`` asserts the analytic payload).
+
+    Placement tables (``moe/balancer.py`` AutoEP): when ``w`` carries
+    ``place_dest``/``place_slot``/``place_nrep`` leaves, the expert
+    stacks are in PHYSICAL slot order (hot experts replicated, cold ones
+    re-placed) and each routed pair picks a replica deterministically —
+    outputs are bit-identical to the natural layout because replicas are
+    exact weight copies and no pair is ever dropped by placement.
+    Without tables the natural layout applies (expert ``e`` lives on
+    shard ``e // e_local``), which requires ``E % ep == 0``.
     """
+    from deepspeed_tpu.comm import quantized as cq
+
     B, T, D = h.shape
     E = w["router"].shape[-1]
     ep = mesh.shape["ep"]
     k = cfg.top_k
-    if E % ep:
-        raise ValueError(f"num_experts ({E}) must divide by ep ({ep})")
-    if E > 127 * 128 - 1:
-        raise ValueError(f"num_experts ({E}) exceeds the id-lane encoding")
-    e_local = E // ep
+    has_place = all(n in w for n in PLACEMENT_LEAVES)
+    if not has_place and E % ep:
+        raise ValueError(f"num_experts ({E}) must divide by ep ({ep}) "
+                         "without placement tables")
+    e_local = E // ep if not has_place else 0
+    bits = int(a2a_bits if a2a_bits is not None
+               else getattr(cfg, "moe_a2a_bits", 0) or 0)
+    hop = int(a2a_slice if a2a_slice is not None
+              else getattr(cfg, "moe_a2a_slice", 0) or 0)
+    block = int(getattr(cfg, "moe_a2a_block", 512) or 512)
     S = B * T
     s_local = -(-S // ep)          # ceil: pad rows are masked below
     s_pad = s_local * ep
@@ -287,8 +451,21 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
 
         n = s_local * k
         flat_e = topk_idx.reshape(-1)                          # [n]
-        dest = flat_e // e_local                               # owning ep shard
         real_pairs = jnp.repeat(real, k)                       # [n]
+        if has_place:
+            # replica choice spreads a hot expert's pairs round-robin over
+            # its copies; dest/slot come from the balancer's tables
+            rep = ((my * n + jnp.arange(n))
+                   % wl["place_nrep"][flat_e])
+            dest = wl["place_dest"][flat_e, rep]               # owning shard
+            lslot = wl["place_slot"][flat_e, rep]              # its local slot
+        else:
+            dest = flat_e // e_local
+            lslot = flat_e % e_local
+        if _TRACKER is not None:
+            cnt = jnp.sum(jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+                          * real_pairs[:, None].astype(jnp.int32), axis=0)
+            jax.debug.callback(_emit_expert_counts, cnt)
         oh = jax.nn.one_hot(dest, ep, dtype=jnp.int32) \
             * real_pairs[:, None].astype(jnp.int32)
         slot = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=1)  # per-dest pos
@@ -296,30 +473,29 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
         # real pairs under a finite moe_ep_capacity_factor)
         slot = jnp.where(real_pairs, slot, cap)
         tok = jnp.arange(n) // k
-        # expert id rides the activation payload as two bf16-exact lanes
-        # (hi/lo base-128 digits of flat_e+1; 0 = empty slot) — one a2a, not two
-        eid = flat_e + 1
-        id_hi = (eid // 128).astype(dt)
-        id_lo = (eid % 128).astype(dt)
-        payload = jnp.concatenate(
-            [x[tok].astype(dt), id_hi[:, None], id_lo[:, None]], axis=1)
-        send = jnp.zeros((ep, cap, D + 2), dt).at[dest, slot].set(
-            payload, mode="drop")
-        recv = jax.lax.all_to_all(send, "ep", 0, 0, tiled=True)
+        send_x = jnp.zeros((ep, cap, D), dt).at[dest, slot].set(
+            x[tok].astype(dt), mode="drop")
+        # slot id + 1 (0 = empty a2a slot) rides its own exact int32 a2a
+        send_id = jnp.zeros((ep, cap), jnp.int32).at[dest, slot].set(
+            lslot.astype(jnp.int32) + 1, mode="drop")
+        recv_x = cq.moe_all_to_all(send_x, "ep", bits=bits,
+                                   block_size=block, slice_size=hop)
+        recv_id = cq.moe_all_to_all(send_id, "ep", bits=0, slice_size=hop)
 
-        flat = recv.reshape(ep * cap, D + 2)
-        re = (flat[:, D].astype(jnp.int32) * 128
-              + flat[:, D + 1].astype(jnp.int32)) - 1
-        valid = re >= 0
-        local_e = jnp.where(valid, re - my * e_local, 0)
-        rx = jnp.where(valid[:, None], flat[:, :D], 0)  # pad rows → zero io
+        stack = next(v for name, v in wl.items()
+                     if name not in PLACEMENT_LEAVES)
+        slots = stack.shape[0]                                 # local experts
+        re = recv_id.reshape(ep * cap) - 1
+        ok = re >= 0
+        local_e = jnp.where(ok, re, 0)
+        rx = jnp.where(ok[:, None], recv_x.reshape(ep * cap, D), 0)
         order = jnp.argsort(local_e)
         xs = rx[order]
-        group_sizes = jnp.bincount(local_e, length=e_local).astype(jnp.int32)
-        ys = _grouped_ffn(xs, group_sizes, wl, dt)             # [ep*cap, D]
-        y_back = jax.lax.all_to_all(
+        group_sizes = jnp.bincount(local_e, length=slots).astype(jnp.int32)
+        ys = _grouped_ffn(xs, group_sizes, wl, dt, kernel)     # [ep*cap, D]
+        y_back = cq.moe_all_to_all(
             jnp.zeros_like(ys).at[order].set(ys).reshape(ep, cap, D),
-            "ep", 0, 0, tiled=True)
+            "ep", bits=bits, block_size=block, slice_size=hop)
 
         keep = (slot < cap).astype(dt)                         # 1 unless factor drops
         wgt = topk_vals.reshape(-1).astype(dt) * keep          # invalid rows: 0
@@ -329,6 +505,10 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
 
     ew = P("ep", None, None)
     experts = {n: v for n, v in w.items() if n != "router"}
+    # placement tables enter replicated — every shard routes with the same
+    # global view; only the expert stacks are ep-sharded
+    especs = {n: (P(*([None] * v.ndim)) if n in PLACEMENT_LEAVES else ew)
+              for n, v in experts.items()}
     x2 = h.reshape(S, D)
     v2 = (jnp.ones((S,), bool) if valid is None else valid.reshape(S))
     if s_pad != S:
@@ -345,8 +525,7 @@ def _grouped_moe_ep(h: jax.Array, w: Dict[str, jax.Array], cfg: Any,
     # and is what _route computes in anyway.
     out2, aux = jax.shard_map(
         shard, mesh=mesh,
-        in_specs=(P("ep", None), P("ep"), P(None, None),
-                  {n: ew for n in experts}),
+        in_specs=(P("ep", None), P("ep"), P(None, None), especs),
         out_specs=(P("ep", None), P()), axis_names={"ep"},
         check_vma=False)(x2, v2, w["router"].astype(jnp.float32), experts)
     if s_pad != S:

@@ -59,6 +59,10 @@ class ModelProfile:
     active_params: Optional[int] = None
     # the model can shard the sequence axis (ulysses / ring / fpdt attention)
     sp_capable: bool = False
+    # MoE a2a dispatch wire format (comm/quantized.py moe_all_to_all):
+    # 0 = dense bf16 tokens, 4/8 = blockwise-quantized payload
+    moe_a2a_bits: int = 0
+    moe_a2a_block: int = 2048
 
     @property
     def active(self) -> int:
@@ -85,7 +89,9 @@ class ModelProfile:
             hidden=int(cfg.hidden_size), vocab=int(cfg.vocab_size),
             seq=int(seq or cfg.max_seq_len), n_experts=int(cfg.num_experts),
             top_k=int(cfg.top_k), active_params=int(active),
-            sp_capable=cfg.attention_impl in ("ulysses", "ring", "fpdt"))
+            sp_capable=cfg.attention_impl in ("ulysses", "ring", "fpdt"),
+            moe_a2a_bits=int(getattr(cfg, "moe_a2a_bits", 0) or 0),
+            moe_a2a_block=int(getattr(cfg, "moe_a2a_block", 2048) or 2048))
 
     @classmethod
     def from_model(cls, model, seq: Optional[int] = None
@@ -187,6 +193,42 @@ def quantized_wire_ratio(n_elems: int, bits: int, block_size: int) -> float:
     return wire_bytes(n, bits, block_size) / float(n * _WIRE_ITEMSIZE)
 
 
+def moe_a2a_bytes(tok_chip: float, hidden: int, top_k: int, ep: int, *,
+                  itemsize: int = _WIRE_ITEMSIZE, quant_bits: int = 0,
+                  block_size: int = 2048, ici_size: Optional[int] = None,
+                  two_hop: bool = True) -> Dict[str, float]:
+    """Per-chip, per-layer MoE a2a wire bytes by link class (dispatch +
+    combine of the ``top_k``-routed tokens over the ``ep`` axis).
+
+    Mirrors ``comm.quantized.moe_all_to_all``: when the ep axis fits one
+    ICI domain (``ici_size`` absent or >= ep) the whole payload is a
+    single-hop a2a — ``2 * (ep-1)/ep * tok_chip * top_k * hidden *
+    itemsize`` scaled by the quantized wire ratio when ``quant_bits`` is
+    set (identical to the pre-a2a-aware ``per_axis['ep']`` formula at
+    bits=0). When the axis spans DCN (``ici_size`` < ep) the default is
+    the hierarchical two-hop path: only the cross-slice fraction
+    ``(m-1)/m`` (``m = ep/ici_size`` slices) crosses DCN — quantized —
+    while the ``(s-1)/s`` intra-slice hop stays dense on ICI. That split
+    is what lets :func:`enumerate_meshes` + :meth:`CostModel.rank` prefer
+    DCN-spanning ep shapes over DCN-spanning tp/sp ones on multi-slice
+    topologies instead of guessing.
+    """
+    elems = float(tok_chip) * int(top_k) * int(hidden)
+    dense = elems * itemsize
+    r = (quantized_wire_ratio(max(int(elems), 1), quant_bits, block_size)
+         if quant_bits else 1.0)
+    s = ep if ici_size is None else max(1, min(int(ici_size), ep))
+    if s >= ep:
+        ici, dcn = 2 * dense * (ep - 1) / ep * r, 0.0
+    elif not two_hop or s <= 1:
+        ici, dcn = 0.0, 2 * dense * (ep - 1) / ep * r
+    else:
+        m = max(ep // s, 1)
+        dcn = 2 * dense * (m - 1) / m * r
+        ici = 2 * dense * (s - 1) / s
+    return {"ici": ici, "dcn": dcn, "total": ici + dcn}
+
+
 def collective_volumes(profile: ModelProfile, mesh: Dict[str, int], *,
                        zero_stage: int = 0,
                        zero_pp: Optional[Dict[str, Any]] = None,
@@ -248,10 +290,20 @@ def collective_volumes(profile: ModelProfile, mesh: Dict[str, int], *,
         # Ulysses: 4 all-to-alls per layer over the sequence axis
         per_axis["sp"] = (profile.n_layers / p) * 4 * ((s - 1) / s) \
             * tok_chip * profile.hidden * act
+    ep_split = None
     if e > 1:
         # dispatch + combine all-to-alls of top_k-routed tokens per layer
-        per_axis["ep"] = (profile.n_layers / p) * 2 * ((e - 1) / e) \
-            * tok_chip * profile.hidden * act * profile.top_k
+        # (moe_a2a_bytes knows the quantized / hierarchical two-hop wire,
+        # so a DCN-spanning ep axis pays only its cross-slice fraction)
+        per_layer = moe_a2a_bytes(
+            tok_chip, profile.hidden, profile.top_k, e, itemsize=act,
+            quant_bits=profile.moe_a2a_bits,
+            block_size=profile.moe_a2a_block,
+            ici_size=None if ici_sizes is None else ici_sizes.get("ep"))
+        scale = profile.n_layers / p
+        ep_split = {"ici": per_layer["ici"] * scale,
+                    "dcn": per_layer["dcn"] * scale}
+        per_axis["ep"] = per_layer["total"] * scale
     if p > 1:
         # boundary activation p2p, forward + backward
         per_axis["pp"] = 2 * tok_chip * profile.hidden * act
@@ -262,8 +314,13 @@ def collective_volumes(profile: ModelProfile, mesh: Dict[str, int], *,
             return "dcn"
         return "ici"
 
-    ici = sum(v for ax, v in per_axis.items() if link(ax) == "ici")
-    dcn = sum(v for ax, v in per_axis.items() if link(ax) == "dcn")
+    ici = sum(v for ax, v in per_axis.items()
+              if ax != "ep" and link(ax) == "ici")
+    dcn = sum(v for ax, v in per_axis.items()
+              if ax != "ep" and link(ax) == "dcn")
+    if ep_split is not None:
+        ici += ep_split["ici"]
+        dcn += ep_split["dcn"]
     m = max(int(micro_batches), 1)
     bubble = (p - 1) / (m + p - 1) if p > 1 else 0.0
     return {"flops": flops, "ici_bytes": ici, "dcn_bytes": dcn,

@@ -117,7 +117,9 @@ class FaultSpec:
              # replica-lifecycle sites (Replica/FleetController hooks)
              "replica_crash", "slow_start", "weight_load_io_error",
              # cross-replica migration sites (durable pause export / adopt)
-             "migrate_io_error", "manifest_torn", "crash_during_pause_export")
+             "migrate_io_error", "manifest_torn", "crash_during_pause_export",
+             # MoE expert-parallel a2a dispatch (engine_v2 hook)
+             "moe_a2a_error")
 
     def __post_init__(self):
         if self.kind not in self.KINDS:
@@ -245,6 +247,20 @@ class FaultInjector:
                 self._record(spec, f"serving:{site}")
                 raise InjectedIOError(
                     f"injected KV-cache IO failure ({site})")
+
+    def on_moe_dispatch(self, site: str) -> None:
+        """Hook at the engine's expert-parallel MoE dispatch (``site``:
+        ``prefill`` | ``decode``), fired just before the step that carries
+        the token all-to-all. ``moe_a2a_error`` raises mid-dispatch — the
+        batcher must absorb it like any failed serving step (requests
+        retried or shed, never silently lost), which is exactly what the
+        ``moe-storm`` drill asserts."""
+        for spec in self.faults:
+            if spec.kind == "moe_a2a_error" \
+                    and spec.site in (None, site) and self._take(spec):
+                self._record(spec, f"moe_a2a:{site}")
+                raise InjectedIOError(
+                    f"injected MoE all-to-all failure ({site})")
 
     def maybe_poison_logits(self, logits):
         """Return ``logits`` poisoned to NaN when a ``decode_nan`` fault

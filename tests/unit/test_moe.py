@@ -327,3 +327,113 @@ def test_capacity_moe_decode_ignores_idle_lanes(eight_devices):
         assert eng.state.sequences[5].slot == 4
         np.testing.assert_allclose(np.asarray(r[5], np.float32), ref,
                                    atol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# dropless grouped kernels: ragged_dot vs the padded one-hot einsum
+# ---------------------------------------------------------------------------
+
+class TestDroplessKernels:
+    @staticmethod
+    def _weights(D, F, E, seed=0):
+        rng = jax.random.split(jax.random.key(seed), 4)
+        return {"router": jax.random.normal(rng[0], (D, E)) * 0.1,
+                "w_gate": jax.random.normal(rng[1], (E, D, F)) / 4,
+                "w_up": jax.random.normal(rng[2], (E, D, F)) / 4,
+                "w_down": jax.random.normal(rng[3], (E, F, D)) / 6}
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("shape", [(2, 16), (1, 13), (3, 7)])
+    def test_ragged_padded_bit_identity(self, top_k, shape):
+        """fp32 outputs of the ragged grouped GEMM and the padded one-hot
+        einsum reference are BITWISE identical — including odd token
+        counts and B=1 decode shapes — so flipping ``moe.kernel`` can
+        never change greedy decode output."""
+        from deepspeed_tpu.moe import grouped_moe_mlp_block
+
+        class Cfg:
+            pass
+
+        Cfg.top_k = top_k
+        w = self._weights(16, 32, 4, seed=top_k)
+        h = jax.random.normal(jax.random.key(9), (*shape, 16), jnp.float32)
+        jfn = jax.jit(grouped_moe_mlp_block, static_argnums=2,
+                      static_argnames=("kernel",))
+        yr, ar = jfn(h, w, Cfg, kernel="ragged")
+        yp, ap = jfn(h, w, Cfg, kernel="padded")
+        np.testing.assert_array_equal(np.asarray(yr), np.asarray(yp))
+        assert float(ar) == float(ap)
+
+    def test_dropless_beats_capacity_overflow(self):
+        """Regression vs the capacity path: route EVERY token to one
+        expert — the capacity einsum drops most of them, the grouped path
+        drops none (each token keeps its full top-k contribution)."""
+        from deepspeed_tpu.moe import grouped_moe_mlp_block, moe_mlp_block
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        class Tight:
+            top_k = 1
+            capacity_factor = 1.0
+            min_capacity = 1
+
+        D, F, E = 16, 32, 4
+        w = self._weights(D, F, E, seed=3)
+        # a router column so dominant every token picks expert 2
+        # (positive activations so the +50 column cannot sign-flip)
+        w["router"] = w["router"].at[:, 2].add(50.0)
+        h = jax.random.uniform(jax.random.key(5), (1, 32, D), jnp.float32,
+                               0.05, 1.0)
+        logits = h.reshape(-1, D) @ w["router"]
+        _, _, _, stats = topk_gating(logits, k=1, capacity_factor=1.0,
+                                     min_capacity=1)
+        # capacity cap = S*f/E = 8 of 32 tokens survive the einsum path
+        assert float(stats["drop_fraction"]) >= 0.5
+        yg, _ = grouped_moe_mlp_block(h, w, Tight)
+        yc, _ = moe_mlp_block(h, w, Tight)
+        dropped = np.asarray(jnp.sum(jnp.abs(yc), -1) == 0)
+        kept_g = np.asarray(jnp.sum(jnp.abs(yg), -1) > 0)
+        assert dropped.sum() >= 16          # the einsum really dropped
+        assert kept_g.all()                 # the grouped path kept all
+
+    def test_resolve_kernel_and_fallback_warning(self, monkeypatch, caplog):
+        """``moe.kernel: ragged`` degrades to padded with exactly ONE
+        logged warning when the grouped GEMM cannot lower; bad names are
+        rejected; ``padded`` never consults the probe."""
+        import logging
+
+        from deepspeed_tpu.moe import sharded_moe as sm
+
+        with pytest.raises(ValueError):
+            sm.resolve_moe_kernel("cutlass")
+        assert sm.resolve_moe_kernel("padded") == ("padded", "")
+        # this host lowers ragged_dot (the probe is memoized)
+        assert sm.resolve_moe_kernel("ragged")[0] == "ragged"
+        monkeypatch.setattr(sm, "_SUPPORT_MEMO", (None, "forced by test"))
+        monkeypatch.setattr(sm, "_FALLBACK_WARNED", False)
+        with caplog.at_level(logging.WARNING):
+            k1, why1 = sm.resolve_moe_kernel("ragged")
+            k2, _ = sm.resolve_moe_kernel("ragged")
+        assert (k1, k2) == ("padded", "padded") and why1 == "forced by test"
+        warned = [r for r in caplog.records
+                  if "falling back" in r.getMessage()]
+        assert len(warned) <= 1
+
+    def test_kernel_config_plumbing(self):
+        """The knob exists at every layer: MoEConfig validates it, the
+        transformer config carries it, the probe reports this backend."""
+        from deepspeed_tpu.config.config import MoEConfig
+        from deepspeed_tpu.moe import MOE_KERNELS, moe_kernel_support
+
+        assert MoEConfig(kernel="padded").kernel == "padded"
+        assert MoEConfig(a2a_bits=8).a2a_bits == 8
+        with pytest.raises(Exception):
+            MoEConfig(kernel="blocked")
+        with pytest.raises(Exception):
+            MoEConfig(a2a_bits=3)
+        cfg = get_preset("tiny", num_experts=4, moe_kernel="padded",
+                         moe_a2a_bits=8, moe_a2a_slice=2)
+        assert (cfg.moe_kernel, cfg.moe_a2a_bits, cfg.moe_a2a_slice) == \
+            ("padded", 8, 2)
+        assert set(MOE_KERNELS) == {"ragged", "padded"}
+        mode, why = moe_kernel_support()
+        assert mode in (None, "native") and why

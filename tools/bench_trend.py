@@ -88,6 +88,13 @@ TRACKED = {
     # post-crash decode throughput must not crater
     "bench_migration": [("migration_success_rate", "higher"),
                         ("resumed_tokens_per_sec", "higher")],
+    # expert-parallel MoE serving sweep (bench.py --ep-sweep): decode
+    # throughput per (experts, ep-width, kernel) cell, the dropless
+    # ragged/padded speedup at equal config, and the per-expert load
+    # balance (mean/max; 1.0 = even) the AutoEP planner optimises
+    "bench_moe": [("moe.*.tokens_per_sec", "higher"),
+                  ("moe.*.ragged_speedup", "higher"),
+                  ("moe.*.balance", "higher")],
 }
 
 

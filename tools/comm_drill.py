@@ -14,10 +14,15 @@
   loss parity and logs its hops under the documented op names, and hpZ
   falls back gracefully on a single-slice mesh.
 
+* the MoE expert-dispatch all-to-alls (dense / quantized / hierarchical
+  two-hop) log exactly the ``moe_a2a_wire_bytes`` analytic payload, and a
+  full traced ``_grouped_moe_ep`` dispatch decomposes into those terms.
+
     python tools/comm_drill.py --list
     python tools/comm_drill.py --scenario bytes
     python tools/comm_drill.py --scenario parity
     python tools/comm_drill.py --scenario two-hop
+    python tools/comm_drill.py --scenario moe-a2a
     python tools/comm_drill.py --all
 
 Exit code 0 = invariants held; 1 = violated (details on stdout as JSON).
@@ -287,10 +292,82 @@ def scenario_two_hop(workdir=None):
             "comm_bytes": cb, "hpz_fallback": True}
 
 
+def scenario_moe_a2a(workdir=None):
+    """MoE expert-dispatch a2a wire accounting: every ``moe_all_to_all``
+    form (dense / int8 / int4 / hierarchical two-hop) logs exactly the
+    analytic payload of ``moe_a2a_wire_bytes``, and a full traced
+    ``_grouped_moe_ep`` dispatch (x out, ids out, y back) decomposes into
+    those same terms — the instrument the bench_moe ledger rides is
+    itself pinned."""
+    import types
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm import quantized as cq
+    from deepspeed_tpu.moe import sharded_moe as sm
+    from deepspeed_tpu.parallel import build_mesh
+
+    lg = _logger()
+    topo = build_mesh(axis_sizes={"ep": 8})
+    cap, D, bs = 16, 32, 256
+
+    def traced_bytes(fn, x, in_spec, out_spec):
+        before = dict(lg.bytes)
+        jax.make_jaxpr(jax.shard_map(fn, mesh=topo.mesh, in_specs=in_spec,
+                                     out_specs=out_spec,
+                                     check_vma=False))(x)
+        return _delta(before, dict(lg.bytes))
+
+    x = jnp.zeros((8, cap, D), jnp.bfloat16)
+    cases = []
+    for bits, sl in [(0, 0), (8, 0), (4, 0), (8, 2), (0, 2), (4, 4)]:
+        d = traced_bytes(
+            lambda v, b=bits, s=sl: cq.moe_all_to_all(
+                v, "ep", bits=b, block_size=bs, slice_size=s),
+            x, P(None, None, None), P(None, None, None))
+        want = {k: v for k, v in cq.moe_a2a_wire_bytes(
+            8, cap * D, bits=bits, block_size=bs, slice_size=sl,
+            itemsize=2).items() if v}
+        cases.append((f"moe a2a bits={bits} slice={sl}", d, want))
+    for name, got, want in cases:
+        check(got == want, f"moe a2a byte mismatch: {name}",
+              {"got": got, "want": want})
+
+    # full dispatch: 2 payload a2as (x out + y back) + 1 exact id a2a,
+    # every term under the documented op keys
+    E, Dm, top_k, B, T = 8, 16, 2, 4, 4
+    cfg = types.SimpleNamespace(top_k=top_k, moe_ep_capacity_factor=0.0,
+                                moe_kernel="ragged", moe_a2a_bits=8,
+                                moe_a2a_slice=2, moe_a2a_block=bs)
+    w = {"router": jnp.zeros((Dm, E), jnp.float32),
+         "w_gate": jnp.zeros((E, Dm, 32), jnp.float32),
+         "w_up": jnp.zeros((E, Dm, 32), jnp.float32),
+         "w_down": jnp.zeros((E, 32, Dm), jnp.float32)}
+    h = jnp.zeros((B, T, Dm), jnp.float32)
+    before = dict(lg.bytes)
+    with jax.sharding.set_mesh(topo.mesh):
+        jax.make_jaxpr(lambda hh: sm.grouped_moe_mlp_block(hh, w, cfg))(h)
+    got = _delta(before, dict(lg.bytes))
+    ep_cap = -(-B * T // 8) * top_k         # s_local * top_k, dropless
+    xw = cq.moe_a2a_wire_bytes(8, ep_cap * Dm, bits=8, block_size=bs,
+                               slice_size=2, itemsize=4)
+    iw = cq.moe_a2a_wire_bytes(8, ep_cap, bits=0, block_size=bs,
+                               slice_size=2, itemsize=4)
+    want = {k: v for k, v in
+            {k: 2 * xw[k] + iw[k] for k in xw}.items() if v}
+    check(got == want, "full _grouped_moe_ep dispatch bytes mismatch",
+          {"got": got, "want": want})
+    return {"cases": [{"op": c[0], "bytes": c[1]} for c in cases],
+            "full_dispatch": {k: int(v) for k, v in got.items()}}
+
+
 SCENARIOS = {
     "bytes": scenario_bytes,
     "parity": scenario_parity,
     "two-hop": scenario_two_hop,
+    "moe-a2a": scenario_moe_a2a,
 }
 
 
